@@ -1,0 +1,62 @@
+"""apex_tpu — a TPU-native rebuild of NVIDIA Apex (reference: alpha0422/apex).
+
+Apex is a collection of CUDA-fused training extensions layered on PyTorch
+(reference layout: ``apex/__init__.py``).  apex_tpu provides the same
+capability surface — a mixed-precision engine (``apex_tpu.amp``), fused
+multi-tensor optimizers (``apex_tpu.optimizers``), fused norm/attention/loss
+ops (``apex_tpu.normalization``, ``apex_tpu.contrib``), a data-parallel layer
+(``apex_tpu.parallel``), and a Megatron-style tensor/pipeline/sequence
+parallel stack (``apex_tpu.transformer``) — designed TPU-first:
+
+* device code is JAX/XLA with Pallas (Mosaic) kernels where fusion matters,
+  instead of CUDA;
+* collectives are GSPMD shardings / ``shard_map`` collectives compiled over
+  ICI/DCN, instead of NCCL;
+* mixed precision lowers to bf16 dtype policies with (optional) dynamic loss
+  scaling, instead of monkey-patched fp16 casts.
+
+The package is functional: optimizers and amp states are explicit pytrees
+(JAX-style), but constructor signatures and module names mirror apex so a
+user of the reference can find every component under the same name.
+"""
+
+from apex_tpu._version import __version__
+
+# Subpackages are imported lazily to keep `import apex_tpu` cheap and to let
+# optional pieces degrade independently (mirrors apex/__init__.py's guarded
+# optional imports of amp/fp16_utils/optimizers/normalization/...).
+import importlib as _importlib
+
+_SUBMODULES = (
+    "amp",
+    "contrib",
+    "fp16_utils",
+    "fused_dense",
+    "mlp",
+    "models",
+    "multi_tensor_apply",
+    "normalization",
+    "ops",
+    "optimizers",
+    "parallel",
+    "transformer",
+    "utils",
+)
+
+
+def __getattr__(name):
+    if name in _SUBMODULES:
+        try:
+            return _importlib.import_module(f"apex_tpu.{name}")
+        except ModuleNotFoundError as e:
+            if e.name == f"apex_tpu.{name}":
+                # Keep hasattr()/getattr(default) feature-probing working —
+                # the apex pattern for optional components.
+                raise AttributeError(
+                    f"apex_tpu submodule {name!r} is not available") from None
+            raise
+    raise AttributeError(f"module 'apex_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_SUBMODULES))

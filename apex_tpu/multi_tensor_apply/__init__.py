@@ -1,0 +1,33 @@
+from apex_tpu.multi_tensor_apply.bucketing import (
+    LANE,
+    DEFAULT_BLOCK_ROWS,
+    BucketMeta,
+    bucket_meta,
+    flatten_bucket,
+    unflatten_bucket,
+    row_tensor_ids,
+    group_by_dtype,
+)
+from apex_tpu.multi_tensor_apply.functional import (
+    MultiTensorApply,
+    multi_tensor_applier,
+    multi_tensor_scale,
+    multi_tensor_axpby,
+    multi_tensor_l2norm,
+)
+
+__all__ = [
+    "LANE",
+    "DEFAULT_BLOCK_ROWS",
+    "BucketMeta",
+    "bucket_meta",
+    "flatten_bucket",
+    "unflatten_bucket",
+    "row_tensor_ids",
+    "group_by_dtype",
+    "MultiTensorApply",
+    "multi_tensor_applier",
+    "multi_tensor_scale",
+    "multi_tensor_axpby",
+    "multi_tensor_l2norm",
+]

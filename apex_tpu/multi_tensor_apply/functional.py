@@ -1,0 +1,167 @@
+"""Tensor-list level multi-tensor ops (functional).
+
+These are the TPU equivalents of the ``amp_C.multi_tensor_*`` entry points as
+*used through* ``apex.multi_tensor_apply.multi_tensor_applier``: they take
+lists of arbitrarily-shaped tensors, group them by dtype, pack each group
+into one ``(rows, 128)`` buffer, run ONE Pallas kernel per group, and return
+new tensor lists (JAX is functional — apex mutates in place).
+
+The ``found_inf`` flag returned by scale/axpby/l2norm is the functional
+analogue of apex's ``overflow_buf``/``noop`` buffer.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.multi_tensor_apply import bucketing as B
+from apex_tpu.ops import multi_tensor as K
+
+_f32 = jnp.float32
+
+
+@functools.lru_cache(maxsize=512)
+def _meta(shapes: tuple, dtype_str: str, block_rows: int) -> B.BucketMeta:
+    return B.bucket_meta(shapes, jnp.dtype(dtype_str), block_rows)
+
+
+def _meta_for(tensors: Sequence[jax.Array], dtype=None,
+              block_rows: int = B.DEFAULT_BLOCK_ROWS) -> B.BucketMeta:
+    shapes = tuple(tuple(t.shape) for t in tensors)
+    dtype = jnp.dtype(dtype or tensors[0].dtype)
+    return _meta(shapes, str(dtype), block_rows)
+
+
+@functools.lru_cache(maxsize=512)
+def _row_ids_cached(meta: B.BucketMeta):
+    return jnp.asarray(B.row_tensor_ids(meta))
+
+
+def _per_tensor_from_rowsq(rowsq: jax.Array, meta: B.BucketMeta) -> jax.Array:
+    """Segment-reduce per-row sums of squares into per-tensor sums."""
+    ids = _row_ids_cached(meta)
+    return jax.ops.segment_sum(rowsq[:, 0], ids,
+                               num_segments=len(meta.shapes))
+
+
+def multi_tensor_scale(tensors: Sequence[jax.Array], scale, out_dtype=None,
+                       block_rows: int = B.DEFAULT_BLOCK_ROWS):
+    """out_i = tensor_i * scale for all i; returns (outs, found_inf).
+
+    Reference: ``csrc/multi_tensor_scale_kernel.cu`` via
+    ``amp_C.multi_tensor_scale`` (used by amp unscale + master-grad copies).
+    """
+    groups = B.group_by_dtype(tensors)
+    outs: list = [None] * len(tensors)
+    finf = jnp.zeros((), _f32)
+    for dt, idxs in groups.items():
+        ts = [tensors[i] for i in idxs]
+        meta = _meta_for(ts, dt, block_rows)
+        packed = B.flatten_bucket(ts, meta)
+        od = out_dtype or dt
+        out_packed, f = K.scale_packed(packed, scale, od,
+                                       block_rows=block_rows)
+        out_meta = meta._replace(dtype=jnp.dtype(od))
+        for i, t in zip(idxs, B.unflatten_bucket(out_packed, out_meta)):
+            outs[i] = t
+        finf = jnp.maximum(finf, f)
+    return outs, finf
+
+
+def multi_tensor_axpby(a, xs: Sequence[jax.Array], b, ys: Sequence[jax.Array],
+                       out_dtype=None,
+                       block_rows: int = B.DEFAULT_BLOCK_ROWS):
+    """out_i = a*x_i + b*y_i; returns (outs, found_inf).
+
+    Reference: ``csrc/multi_tensor_axpby_kernel.cu``.
+    """
+    assert len(xs) == len(ys)
+    groups = B.group_by_dtype(xs)
+    outs: list = [None] * len(xs)
+    finf = jnp.zeros((), _f32)
+    for dt, idxs in groups.items():
+        xg = [xs[i] for i in idxs]
+        yg = [ys[i] for i in idxs]
+        meta_x = _meta_for(xg, dt, block_rows)
+        meta_y = _meta_for(yg, yg[0].dtype, block_rows)
+        od = out_dtype or dt
+        out_packed, f = K.axpby_packed(
+            a, B.flatten_bucket(xg, meta_x), b,
+            B.flatten_bucket(yg, meta_y), od, block_rows=block_rows)
+        out_meta = meta_x._replace(dtype=jnp.dtype(od))
+        for i, t in zip(idxs, B.unflatten_bucket(out_packed, out_meta)):
+            outs[i] = t
+        finf = jnp.maximum(finf, f)
+    return outs, finf
+
+
+def multi_tensor_l2norm(tensors: Sequence[jax.Array], per_tensor: bool = False,
+                        block_rows: int = B.DEFAULT_BLOCK_ROWS):
+    """Global L2 norm over all tensors (and per-tensor norms if asked).
+
+    Returns ``(norm, per_tensor_norms, found_inf)``; ``per_tensor_norms`` is
+    an f32 vector aligned with the input order, or None.
+    Reference: ``csrc/multi_tensor_l2norm_kernel.cu`` (per-tensor variant =
+    apex's ``per_tensor_python=True``).
+    """
+    groups = B.group_by_dtype(tensors)
+    total = jnp.zeros((), _f32)
+    finf = jnp.zeros((), _f32)
+    per = jnp.zeros((len(tensors),), _f32) if per_tensor else None
+    for dt, idxs in groups.items():
+        ts = [tensors[i] for i in idxs]
+        meta = _meta_for(ts, dt, block_rows)
+        packed = B.flatten_bucket(ts, meta)
+        rowsq, f = K.l2norm_rowsq_packed(packed, block_rows=block_rows)
+        total = total + jnp.sum(rowsq)
+        finf = jnp.maximum(finf, f)
+        if per_tensor:
+            seg = _per_tensor_from_rowsq(rowsq, meta)
+            per = per.at[jnp.asarray(idxs)].set(jnp.sqrt(seg))
+    return jnp.sqrt(total), per, finf
+
+
+class MultiTensorApply:
+    """API-parity shim for ``apex.multi_tensor_apply.MultiTensorApply``.
+
+    In apex this dispatches a CUDA kernel over chunked pointer lists:
+    ``multi_tensor_applier(op, overflow_buf, tensor_lists, *args)`` where
+    tensor_lists follows each op's convention (scale: ``[in, out]``, axpby:
+    ``[x, y, out]``, l2norm: ``[in]``).  JAX is functional, so "out" lists
+    are ignored and the new tensors are *returned*; ``noop_flag`` maps to the
+    returned ``found_inf``.  The chunk size maps to the Pallas block row
+    count (elements per block ≈ ``chunk_size``, rounded to a lane multiple).
+    """
+
+    available = True
+    warned = False
+
+    def __init__(self, chunk_size: int = B.DEFAULT_BLOCK_ROWS * B.LANE):
+        self.chunk_size = int(chunk_size)
+        self.block_rows = max(8, self.chunk_size // B.LANE)
+
+    def __call__(self, op, noop_flag, tensor_lists, *args, **kwargs):
+        params = inspect.signature(op).parameters
+        kw = dict(kwargs)
+        if "noop_flag" in params and "noop_flag" not in kw:
+            kw["noop_flag"] = noop_flag
+        if "block_rows" in params and "block_rows" not in kw:
+            kw["block_rows"] = self.block_rows
+        if op is multi_tensor_scale:
+            # apex convention: tensor_lists = [in, out]; args = (scale,)
+            return op(tensor_lists[0], *args, **kw)
+        if op is multi_tensor_axpby:
+            # apex convention: tensor_lists = [x, y, out]; args = (a, b, ...)
+            a, b = args[0], args[1]
+            return op(a, tensor_lists[0], b, tensor_lists[1], **kw)
+        if op is multi_tensor_l2norm:
+            return op(tensor_lists[0], *args, **kw)
+        return op(tensor_lists, *args, **kw)
+
+
+multi_tensor_applier = MultiTensorApply()

@@ -1,0 +1,5 @@
+"""Low-level fused ops (Pallas kernels with jnp fallbacks)."""
+
+from apex_tpu.ops import multi_tensor
+
+__all__ = ["multi_tensor"]

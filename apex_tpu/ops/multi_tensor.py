@@ -1,0 +1,522 @@
+"""Multi-tensor fused kernels — the TPU equivalent of apex's ``amp_C``.
+
+Reference surface (``csrc/amp_C_frontend.cpp`` + ``csrc/multi_tensor_*.cu``):
+``multi_tensor_scale``, ``multi_tensor_axpby``, ``multi_tensor_l2norm``,
+``multi_tensor_adam``, ``multi_tensor_sgd``, ``multi_tensor_lamb`` (two
+stages), ``multi_tensor_novograd``, ``multi_tensor_adagrad`` — each updates N
+tensors with one kernel launch and carries a ``noop``/overflow side channel.
+
+TPU design: tensors are packed per dtype into ``(rows, 128)`` buffers (see
+``apex_tpu.multi_tensor_apply.bucketing``); each op is ONE Pallas kernel
+sweeping the buffer with a 1-D grid (block = ``block_rows`` × 128 lanes on
+the VPU), with scalars (lr, betas, loss-scale, …) in SMEM so they are traced
+values — changing the learning rate does not recompile.  The overflow flag is
+an f32 scalar kernel output accumulated across the sequential TPU grid;
+optimizer kernels take a ``noop`` scalar and pass inputs through unchanged
+when it is set, so a dynamic-loss-scale skip costs no host sync (apex
+achieves the same with its ``noop_gpu`` buffer).
+
+The update math of every op lives in ONE pure f32 function (``_*_math``)
+called both from inside the Pallas kernel and from the jnp fallback used
+off-TPU, so the two paths cannot diverge.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex_tpu.multi_tensor_apply.bucketing import LANE
+from apex_tpu.utils.platform import interpret_mode, use_pallas
+
+_f32 = jnp.float32
+
+
+def _use_kernel(*arrays) -> bool:
+    """Route to the Pallas kernel unless off-TPU or a dtype Mosaic lacks.
+
+    TPU Mosaic has no f16 vector type (bf16 is the native half precision);
+    fp16 buckets — kept for apex API parity — take the jnp path, which XLA
+    lowers with f32 compute.
+    """
+    if not use_pallas():
+        return False
+    return all(a.dtype != jnp.float16 for a in arrays)
+
+
+def _grid(nrows: int, block_rows: int):
+    assert nrows % block_rows == 0, (nrows, block_rows)
+    return (nrows // block_rows,)
+
+
+def _block(block_rows: int):
+    return pl.BlockSpec((block_rows, LANE), lambda i: (i, 0),
+                        memory_space=pltpu.VMEM)
+
+
+def _smem():
+    return pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
+def _rowsum_block(block_rows: int):
+    return pl.BlockSpec((block_rows, 1), lambda i: (i, 0),
+                        memory_space=pltpu.VMEM)
+
+
+def _nonfinite_any(x) -> jax.Array:
+    return jnp.logical_not(jnp.all(jnp.isfinite(x)))
+
+
+def _as_noop(noop_flag):
+    if noop_flag is None:
+        return jnp.zeros((1,), jnp.int32)
+    return jnp.asarray(noop_flag, jnp.int32).reshape(1)
+
+
+def _finf_accumulate(finf_ref, x):
+    """Init-at-first-program / max-accumulate an overflow flag in SMEM.
+
+    Relies on the TPU grid executing sequentially (Pallas TPU semantics).
+    """
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        finf_ref[0, 0] = 0.0
+
+    finf_ref[0, 0] = jnp.maximum(finf_ref[0, 0],
+                                 _nonfinite_any(x).astype(_f32))
+
+
+# ---------------------------------------------------------------------------
+# scale  (csrc/multi_tensor_scale_kernel.cu)
+# ---------------------------------------------------------------------------
+
+def _scale_kernel(scal_ref, x_ref, out_ref, finf_ref):
+    x = x_ref[:].astype(_f32) * scal_ref[0]
+    _finf_accumulate(finf_ref, x)
+    out_ref[:] = x.astype(out_ref.dtype)
+
+
+def scale_packed(x: jax.Array, scale, out_dtype=None, *, block_rows: int):
+    """``out = x * scale`` with fused non-finite detection.
+
+    Returns ``(out, found_inf)`` where ``found_inf`` is f32 0.0/1.0.
+    """
+    out_dtype = out_dtype or x.dtype
+    scale = jnp.asarray(scale, _f32).reshape(1)
+    if not _use_kernel(x):
+        xf = x.astype(_f32) * scale[0]
+        return xf.astype(out_dtype), _nonfinite_any(xf).astype(_f32)
+    out, finf = pl.pallas_call(
+        _scale_kernel,
+        grid=_grid(x.shape[0], block_rows),
+        in_specs=[_smem(), _block(block_rows)],
+        out_specs=[_block(block_rows), _smem()],
+        out_shape=[jax.ShapeDtypeStruct(x.shape, out_dtype),
+                   jax.ShapeDtypeStruct((1, 1), _f32)],
+        interpret=interpret_mode(),
+    )(scale, x)
+    return out, finf[0, 0]
+
+
+# ---------------------------------------------------------------------------
+# axpby  (csrc/multi_tensor_axpby_kernel.cu)
+# ---------------------------------------------------------------------------
+
+def _axpby_kernel(scal_ref, x_ref, y_ref, out_ref, finf_ref):
+    out = scal_ref[0] * x_ref[:].astype(_f32) + scal_ref[1] * y_ref[:].astype(_f32)
+    _finf_accumulate(finf_ref, out)
+    out_ref[:] = out.astype(out_ref.dtype)
+
+
+def axpby_packed(a, x: jax.Array, b, y: jax.Array, out_dtype=None, *,
+                 block_rows: int):
+    """``out = a*x + b*y`` with fused non-finite detection."""
+    out_dtype = out_dtype or x.dtype
+    scal = jnp.stack([jnp.asarray(a, _f32), jnp.asarray(b, _f32)])
+    if not _use_kernel(x, y):
+        out = scal[0] * x.astype(_f32) + scal[1] * y.astype(_f32)
+        return out.astype(out_dtype), _nonfinite_any(out).astype(_f32)
+    out, finf = pl.pallas_call(
+        _axpby_kernel,
+        grid=_grid(x.shape[0], block_rows),
+        in_specs=[_smem(), _block(block_rows), _block(block_rows)],
+        out_specs=[_block(block_rows), _smem()],
+        out_shape=[jax.ShapeDtypeStruct(x.shape, out_dtype),
+                   jax.ShapeDtypeStruct((1, 1), _f32)],
+        interpret=interpret_mode(),
+    )(scal, x, y)
+    return out, finf[0, 0]
+
+
+# ---------------------------------------------------------------------------
+# l2norm  (csrc/multi_tensor_l2norm_kernel.cu)
+# ---------------------------------------------------------------------------
+
+def _l2norm_kernel(x_ref, rowsq_ref, finf_ref):
+    x = x_ref[:].astype(_f32)
+    _finf_accumulate(finf_ref, x)
+    rowsq_ref[:] = jnp.sum(x * x, axis=1, keepdims=True)
+
+
+def l2norm_rowsq_packed(x: jax.Array, *, block_rows: int):
+    """Per-row sum-of-squares ``(rows, 1)`` plus non-finite flag.
+
+    The caller reduces row sums to a global norm (``sqrt(sum)``) and/or
+    per-tensor norms via a row→tensor segment-sum, giving apex's
+    ``per_tensor_python`` variant (multi_tensor_l2norm_kernel.cu).
+    """
+    if not _use_kernel(x):
+        xf = x.astype(_f32)
+        return (jnp.sum(xf * xf, axis=1, keepdims=True),
+                _nonfinite_any(xf).astype(_f32))
+    rowsq, finf = pl.pallas_call(
+        _l2norm_kernel,
+        grid=_grid(x.shape[0], block_rows),
+        in_specs=[_block(block_rows)],
+        out_specs=[_rowsum_block(block_rows), _smem()],
+        out_shape=[jax.ShapeDtypeStruct((x.shape[0], 1), _f32),
+                   jax.ShapeDtypeStruct((1, 1), _f32)],
+        interpret=interpret_mode(),
+    )(x)
+    return rowsq, finf[0, 0]
+
+
+# ---------------------------------------------------------------------------
+# adam  (csrc/multi_tensor_adam.cu)
+# ---------------------------------------------------------------------------
+
+def _adam_math(adam_w_mode, scal, skip, g, p, m, v):
+    """Pure f32 Adam/AdamW update — single source of truth for kernel+fallback.
+
+    scal: [lr, beta1, beta2, eps, weight_decay, bc1, bc2, grad_scale]
+    """
+    lr, beta1, beta2, eps, wd, bc1, bc2, gscale = (scal[k] for k in range(8))
+    g = g * gscale
+    if not adam_w_mode:            # classic Adam: L2 folded into the gradient
+        g = g + wd * p
+    m_new = beta1 * m + (1.0 - beta1) * g
+    v_new = beta2 * v + (1.0 - beta2) * g * g
+    update = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+    if adam_w_mode:                # AdamW: decoupled weight decay
+        update = update + wd * p
+    p_new = p - lr * update
+    return (jnp.where(skip, p, p_new),
+            jnp.where(skip, m, m_new),
+            jnp.where(skip, v, v_new))
+
+
+def _adam_kernel(adam_w_mode, scal_ref, noop_ref, g_ref, p_ref, m_ref, v_ref,
+                 p_out, m_out, v_out):
+    skip = noop_ref[0] != 0
+    p_new, m_new, v_new = _adam_math(
+        adam_w_mode, scal_ref, skip, g_ref[:].astype(_f32),
+        p_ref[:].astype(_f32), m_ref[:].astype(_f32), v_ref[:].astype(_f32))
+    p_out[:] = p_new.astype(p_out.dtype)
+    m_out[:] = m_new.astype(m_out.dtype)
+    v_out[:] = v_new.astype(v_out.dtype)
+
+
+def adam_packed(g, p, m, v, *, lr, beta1, beta2, eps, weight_decay,
+                bias_correction1, bias_correction2, grad_scale=1.0,
+                adam_w_mode=True, noop_flag=None, block_rows: int):
+    """One fused Adam/AdamW step over a packed bucket → ``(p, m, v)``.
+
+    ``bias_correction{1,2}`` are ``1 - beta^t`` computed by the caller
+    (pass 1.0 to disable).  ``grad_scale`` multiplies gradients (use
+    ``1/loss_scale`` to fuse amp unscaling into the step).  When
+    ``noop_flag`` is non-zero the step is skipped on-device.
+    """
+    scal = jnp.stack([jnp.asarray(s, _f32) for s in
+                      (lr, beta1, beta2, eps, weight_decay,
+                       bias_correction1, bias_correction2, grad_scale)])
+    noop = _as_noop(noop_flag)
+    if not _use_kernel(g, p, m, v):
+        p_new, m_new, v_new = _adam_math(
+            bool(adam_w_mode), scal, noop[0] != 0, g.astype(_f32),
+            p.astype(_f32), m.astype(_f32), v.astype(_f32))
+        return (p_new.astype(p.dtype), m_new.astype(m.dtype),
+                v_new.astype(v.dtype))
+    kernel = functools.partial(_adam_kernel, bool(adam_w_mode))
+    return pl.pallas_call(
+        kernel,
+        grid=_grid(p.shape[0], block_rows),
+        in_specs=[_smem(), _smem()] + [_block(block_rows)] * 4,
+        out_specs=[_block(block_rows)] * 3,
+        out_shape=[jax.ShapeDtypeStruct(p.shape, p.dtype),
+                   jax.ShapeDtypeStruct(m.shape, m.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)],
+        input_output_aliases={3: 0, 4: 1, 5: 2},
+        interpret=interpret_mode(),
+    )(scal, noop, g, p, m, v)
+
+
+# ---------------------------------------------------------------------------
+# sgd  (csrc/multi_tensor_sgd_kernel.cu)
+# ---------------------------------------------------------------------------
+
+def _sgd_math(nesterov, first_run, wd_after_momentum, momentum_zero,
+              scal, skip, g, p, buf):
+    """Pure f32 SGD update.  scal: [lr, wd, momentum, dampening, grad_scale]."""
+    lr, wd, mom_c, damp, gscale = (scal[k] for k in range(5))
+    g = g * gscale
+    if not wd_after_momentum:
+        g = g + wd * p
+    if momentum_zero:
+        new_buf, upd = buf, g
+    else:
+        new_buf = g if first_run else mom_c * buf + (1.0 - damp) * g
+        upd = g + mom_c * new_buf if nesterov else new_buf
+    if wd_after_momentum:
+        upd = upd + wd * p
+    p_new = p - lr * upd
+    return jnp.where(skip, p, p_new), jnp.where(skip, buf, new_buf)
+
+
+def _sgd_kernel(flags, scal_ref, noop_ref, g_ref, p_ref, mom_ref,
+                p_out, mom_out):
+    skip = noop_ref[0] != 0
+    p_new, buf_new = _sgd_math(*flags, scal_ref, skip,
+                               g_ref[:].astype(_f32), p_ref[:].astype(_f32),
+                               mom_ref[:].astype(_f32))
+    p_out[:] = p_new.astype(p_out.dtype)
+    mom_out[:] = buf_new.astype(mom_out.dtype)
+
+
+def sgd_packed(g, p, mom, *, lr, weight_decay, momentum, dampening,
+               nesterov=False, first_run=False, wd_after_momentum=False,
+               grad_scale=1.0, noop_flag=None, block_rows: int):
+    """One fused SGD(+momentum) step over a packed bucket → ``(p, mom)``.
+
+    ``momentum`` may be traced; the momentum==0 shortcut (apex's
+    ``momentum_mode``) only engages when it is a concrete Python number.
+    """
+    scal = jnp.stack([jnp.asarray(s, _f32) for s in
+                      (lr, weight_decay, momentum, dampening, grad_scale)])
+    noop = _as_noop(noop_flag)
+    momentum_zero = isinstance(momentum, (int, float)) and momentum == 0.0
+    flags = (bool(nesterov), bool(first_run), bool(wd_after_momentum),
+             momentum_zero)
+    if not _use_kernel(g, p, mom):
+        p_new, buf_new = _sgd_math(*flags, scal, noop[0] != 0,
+                                   g.astype(_f32), p.astype(_f32),
+                                   mom.astype(_f32))
+        return p_new.astype(p.dtype), buf_new.astype(mom.dtype)
+    kernel = functools.partial(_sgd_kernel, flags)
+    return pl.pallas_call(
+        kernel,
+        grid=_grid(p.shape[0], block_rows),
+        in_specs=[_smem(), _smem()] + [_block(block_rows)] * 3,
+        out_specs=[_block(block_rows)] * 2,
+        out_shape=[jax.ShapeDtypeStruct(p.shape, p.dtype),
+                   jax.ShapeDtypeStruct(mom.shape, mom.dtype)],
+        input_output_aliases={3: 0, 4: 1},
+        interpret=interpret_mode(),
+    )(scal, noop, g, p, mom)
+
+
+# ---------------------------------------------------------------------------
+# lamb stage 1/2  (csrc/multi_tensor_lamb.cu, _stage_1.cu, _stage_2.cu)
+# ---------------------------------------------------------------------------
+
+def _lamb_stage1_math(adam_w_mode, scal, skip, g, p, m, v):
+    """Pure f32 LAMB stage-1: moments + raw update + row sums of u², p².
+
+    scal: [beta1, beta2, eps, wd, bc1, bc2, grad_scale, clip]
+    """
+    beta1, beta2, eps, wd, bc1, bc2, gscale, clip = (scal[k]
+                                                     for k in range(8))
+    g = g * gscale * clip
+    if not adam_w_mode:
+        g = g + wd * p
+    m_new = beta1 * m + (1.0 - beta1) * g
+    v_new = beta2 * v + (1.0 - beta2) * g * g
+    u = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+    if adam_w_mode:
+        u = u + wd * p
+    u = jnp.where(skip, 0.0, u)
+    return (u,
+            jnp.where(skip, m, m_new),
+            jnp.where(skip, v, v_new),
+            jnp.sum(u * u, axis=1, keepdims=True),
+            jnp.sum(p * p, axis=1, keepdims=True))
+
+
+def _lamb_stage1_kernel(adam_w_mode, scal_ref, noop_ref,
+                        g_ref, p_ref, m_ref, v_ref,
+                        u_out, m_out, v_out, usq_out, psq_out):
+    skip = noop_ref[0] != 0
+    u, m_new, v_new, usq, psq = _lamb_stage1_math(
+        adam_w_mode, scal_ref, skip, g_ref[:].astype(_f32),
+        p_ref[:].astype(_f32), m_ref[:].astype(_f32), v_ref[:].astype(_f32))
+    u_out[:] = u
+    m_out[:] = m_new.astype(m_out.dtype)
+    v_out[:] = v_new.astype(v_out.dtype)
+    usq_out[:] = usq
+    psq_out[:] = psq
+
+
+def lamb_stage1_packed(g, p, m, v, *, beta1, beta2, eps, weight_decay,
+                       bias_correction1, bias_correction2, grad_scale=1.0,
+                       global_grad_clip=1.0, adam_w_mode=True,
+                       noop_flag=None, block_rows: int):
+    """LAMB stage 1: moments + raw update + per-row ‖u‖², ‖p‖² sums.
+
+    Returns ``(u, m, v, u_rowsq, p_rowsq)``.  ``global_grad_clip``
+    pre-multiplies gradients (apex folds global-norm clipping into the
+    kernel the same way).
+    """
+    scal = jnp.stack([jnp.asarray(s, _f32) for s in
+                      (beta1, beta2, eps, weight_decay, bias_correction1,
+                       bias_correction2, grad_scale, global_grad_clip)])
+    noop = _as_noop(noop_flag)
+    if not _use_kernel(g, p, m, v):
+        u, m_new, v_new, usq, psq = _lamb_stage1_math(
+            bool(adam_w_mode), scal, noop[0] != 0, g.astype(_f32),
+            p.astype(_f32), m.astype(_f32), v.astype(_f32))
+        return u, m_new.astype(m.dtype), v_new.astype(v.dtype), usq, psq
+    kernel = functools.partial(_lamb_stage1_kernel, bool(adam_w_mode))
+    nrows = p.shape[0]
+    return pl.pallas_call(
+        kernel,
+        grid=_grid(nrows, block_rows),
+        in_specs=[_smem(), _smem()] + [_block(block_rows)] * 4,
+        out_specs=[_block(block_rows)] * 3 + [_rowsum_block(block_rows)] * 2,
+        out_shape=[jax.ShapeDtypeStruct(p.shape, _f32),
+                   jax.ShapeDtypeStruct(m.shape, m.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype),
+                   jax.ShapeDtypeStruct((nrows, 1), _f32),
+                   jax.ShapeDtypeStruct((nrows, 1), _f32)],
+        input_output_aliases={4: 1, 5: 2},
+        interpret=interpret_mode(),
+    )(scal, noop, g, p, m, v)
+
+
+def _lamb_stage2_kernel(scal_ref, noop_ref, u_ref, p_ref, ratio_ref, p_out):
+    skip = noop_ref[0] != 0
+    p = p_ref[:].astype(_f32)
+    p_new = p - scal_ref[0] * ratio_ref[:] * u_ref[:]
+    p_out[:] = jnp.where(skip, p, p_new).astype(p_out.dtype)
+
+
+def lamb_stage2_packed(u, p, row_ratio, *, lr, noop_flag=None,
+                       block_rows: int):
+    """LAMB stage 2: ``p -= lr * trust_ratio * u`` with per-row ratios."""
+    scal = jnp.asarray(lr, _f32).reshape(1)
+    noop = _as_noop(noop_flag)
+    if not _use_kernel(u, p):
+        skip = noop[0] != 0
+        pf = p.astype(_f32)
+        p_new = pf - scal[0] * row_ratio * u
+        return jnp.where(skip, pf, p_new).astype(p.dtype)
+    return pl.pallas_call(
+        _lamb_stage2_kernel,
+        grid=_grid(p.shape[0], block_rows),
+        in_specs=[_smem(), _smem(), _block(block_rows), _block(block_rows),
+                  _rowsum_block(block_rows)],
+        out_specs=_block(block_rows),
+        out_shape=jax.ShapeDtypeStruct(p.shape, p.dtype),
+        input_output_aliases={3: 0},
+        interpret=interpret_mode(),
+    )(scal, noop, u, p, row_ratio)
+
+
+# ---------------------------------------------------------------------------
+# adagrad  (csrc/multi_tensor_adagrad.cu)
+# ---------------------------------------------------------------------------
+
+def _adagrad_math(scal, skip, g, p, h):
+    """Pure f32 Adagrad update.  scal: [lr, eps, weight_decay, grad_scale]."""
+    lr, eps, wd, gscale = (scal[k] for k in range(4))
+    g = g * gscale + wd * p
+    h_new = h + g * g
+    p_new = p - lr * g / (jnp.sqrt(h_new) + eps)
+    return jnp.where(skip, p, p_new), jnp.where(skip, h, h_new)
+
+
+def _adagrad_kernel(scal_ref, noop_ref, g_ref, p_ref, h_ref, p_out, h_out):
+    skip = noop_ref[0] != 0
+    p_new, h_new = _adagrad_math(scal_ref, skip, g_ref[:].astype(_f32),
+                                 p_ref[:].astype(_f32), h_ref[:].astype(_f32))
+    p_out[:] = p_new.astype(p_out.dtype)
+    h_out[:] = h_new.astype(h_out.dtype)
+
+
+def adagrad_packed(g, p, h, *, lr, eps, weight_decay, grad_scale=1.0,
+                   noop_flag=None, block_rows: int):
+    """One fused Adagrad step over a packed bucket → ``(p, h)``."""
+    scal = jnp.stack([jnp.asarray(s, _f32) for s in
+                      (lr, eps, weight_decay, grad_scale)])
+    noop = _as_noop(noop_flag)
+    if not _use_kernel(g, p, h):
+        p_new, h_new = _adagrad_math(scal, noop[0] != 0, g.astype(_f32),
+                                     p.astype(_f32), h.astype(_f32))
+        return p_new.astype(p.dtype), h_new.astype(h.dtype)
+    return pl.pallas_call(
+        _adagrad_kernel,
+        grid=_grid(p.shape[0], block_rows),
+        in_specs=[_smem(), _smem()] + [_block(block_rows)] * 3,
+        out_specs=[_block(block_rows)] * 2,
+        out_shape=[jax.ShapeDtypeStruct(p.shape, p.dtype),
+                   jax.ShapeDtypeStruct(h.shape, h.dtype)],
+        input_output_aliases={3: 0, 4: 1},
+        interpret=interpret_mode(),
+    )(scal, noop, g, p, h)
+
+
+# ---------------------------------------------------------------------------
+# novograd  (csrc/multi_tensor_novograd.cu)
+# ---------------------------------------------------------------------------
+
+def _novograd_math(scal, skip, g, p, m, v_row):
+    """Pure f32 NovoGrad elementwise stage.
+
+    scal: [lr, beta1, weight_decay, eps, grad_scale]; ``v_row`` is the
+    per-tensor second moment broadcast per row.
+    """
+    lr, beta1, wd, eps, gscale = (scal[k] for k in range(5))
+    g = g * gscale
+    g = g / (jnp.sqrt(v_row) + eps) + wd * p
+    m_new = beta1 * m + g
+    p_new = p - lr * m_new
+    return jnp.where(skip, p, p_new), jnp.where(skip, m, m_new)
+
+
+def _novograd_kernel(scal_ref, noop_ref, g_ref, p_ref, m_ref, vrow_ref,
+                     p_out, m_out):
+    skip = noop_ref[0] != 0
+    p_new, m_new = _novograd_math(scal_ref, skip, g_ref[:].astype(_f32),
+                                  p_ref[:].astype(_f32),
+                                  m_ref[:].astype(_f32), vrow_ref[:])
+    p_out[:] = p_new.astype(p_out.dtype)
+    m_out[:] = m_new.astype(m_out.dtype)
+
+
+def novograd_packed(g, p, m, v_row, *, lr, beta1, weight_decay, eps,
+                    grad_scale=1.0, noop_flag=None, block_rows: int):
+    """NovoGrad elementwise stage: per-tensor second moment ``v`` (already
+    updated by the caller from per-tensor grad norms) is broadcast per row
+    via ``v_row``; returns ``(p, m)``."""
+    scal = jnp.stack([jnp.asarray(s, _f32) for s in
+                      (lr, beta1, weight_decay, eps, grad_scale)])
+    noop = _as_noop(noop_flag)
+    if not _use_kernel(g, p, m):
+        p_new, m_new = _novograd_math(scal, noop[0] != 0, g.astype(_f32),
+                                      p.astype(_f32), m.astype(_f32), v_row)
+        return p_new.astype(p.dtype), m_new.astype(m.dtype)
+    return pl.pallas_call(
+        _novograd_kernel,
+        grid=_grid(p.shape[0], block_rows),
+        in_specs=[_smem(), _smem()] + [_block(block_rows)] * 3
+                 + [_rowsum_block(block_rows)],
+        out_specs=[_block(block_rows)] * 2,
+        out_shape=[jax.ShapeDtypeStruct(p.shape, p.dtype),
+                   jax.ShapeDtypeStruct(m.shape, m.dtype)],
+        input_output_aliases={3: 0, 4: 1},
+        interpret=interpret_mode(),
+    )(scal, noop, g, p, m, v_row)

@@ -1,0 +1,21 @@
+from apex_tpu.utils.platform import (
+    is_tpu_backend,
+    use_pallas,
+    set_force_pallas,
+    interpret_mode,
+)
+from apex_tpu.utils.pytree import (
+    tree_size,
+    tree_cast,
+    tree_zeros_like,
+)
+
+__all__ = [
+    "is_tpu_backend",
+    "use_pallas",
+    "set_force_pallas",
+    "interpret_mode",
+    "tree_size",
+    "tree_cast",
+    "tree_zeros_like",
+]

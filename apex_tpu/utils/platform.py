@@ -1,0 +1,51 @@
+"""Backend/platform helpers.
+
+Apex gates its native kernels at build time (setup.py feature flags) and each
+Python wrapper raises ImportError when its extension is missing.  On TPU the
+equivalent gate is *runtime*: Pallas kernels run on the TPU backend, and every
+op carries a pure-jnp fallback with identical semantics for CPU/GPU (used by
+the unit-test suite running on a fake 8-device CPU mesh).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+
+_FORCE_PALLAS: bool | None = None
+
+
+@functools.lru_cache(maxsize=None)
+def is_tpu_backend() -> bool:
+    """True when the default JAX backend is a TPU (incl. tunneled axon TPU)."""
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover - backend init failure
+        return False
+
+
+def set_force_pallas(value: bool | None) -> None:
+    """Force Pallas kernels on (interpret mode off-TPU) / off, or None=auto."""
+    global _FORCE_PALLAS
+    _FORCE_PALLAS = value
+
+
+def use_pallas() -> bool:
+    """Whether fused ops should lower to Pallas kernels.
+
+    Auto policy: Pallas on TPU, jnp fallback elsewhere.  Override with
+    :func:`set_force_pallas` or ``APEX_TPU_FORCE_PALLAS=1/0``.
+    """
+    if _FORCE_PALLAS is not None:
+        return _FORCE_PALLAS
+    env = os.environ.get("APEX_TPU_FORCE_PALLAS")
+    if env is not None:
+        return env not in ("0", "false", "False")
+    return is_tpu_backend()
+
+
+def interpret_mode() -> bool:
+    """Pallas ``interpret=`` flag: interpret when not actually on TPU."""
+    return not is_tpu_backend()

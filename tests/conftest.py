@@ -1,0 +1,32 @@
+"""Test configuration: run everything on a fake 8-device CPU mesh.
+
+Apex's distributed tests spawn one process per GPU
+(``apex/transformer/testing/distributed_test_base.py``) and skip without
+hardware.  The TPU rebuild does better: XLA can emulate N devices on CPU, so
+every TP/PP/DP test runs hardware-free in one process.  These env vars must
+be set before JAX initializes, hence at conftest import time.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # override axon/TPU: tests are CPU-only
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The axon TPU plugin force-registers itself (jax_platforms becomes
+# "axon,cpu" regardless of the env var) — override after import.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)
+assert jax.default_backend() == "cpu"
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    import numpy as np
+    return np.random.RandomState(1234)
