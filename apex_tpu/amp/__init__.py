@@ -1,0 +1,19 @@
+from apex_tpu.amp.frontend import (AmpState, Properties, initialize)
+from apex_tpu.amp.handle import scale_loss, unscale_step
+from apex_tpu.amp.interpreter import autocast
+from apex_tpu.amp.scaler import LossScaler, LossScaleState
+from apex_tpu.amp.lists import WHITELIST, BLACKLIST, PROMOTE
+
+__all__ = [
+    "AmpState",
+    "Properties",
+    "initialize",
+    "scale_loss",
+    "unscale_step",
+    "autocast",
+    "LossScaler",
+    "LossScaleState",
+    "WHITELIST",
+    "BLACKLIST",
+    "PROMOTE",
+]

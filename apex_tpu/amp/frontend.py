@@ -1,0 +1,155 @@
+"""amp frontend — TPU rebuild of ``apex/amp/frontend.py``.
+
+Opt levels keep apex's meaning, translated to TPU dtypes (half = bf16 by
+default; fp16 available for parity):
+
+* **O0** — fp32 everything (debugging baseline).
+* **O1** — per-op autocast: MXU ops in half, precision-sensitive ops in
+  fp32 (apex: patched functional surface; here: the jaxpr autocast
+  interpreter in ``apex_tpu.amp.interpreter``).
+* **O2** — "almost half": model params and inputs cast to half (except
+  normalization layers when ``keep_batchnorm_fp32``), fp32 master weights
+  held by the optimizer, loss scaling.
+* **O3** — half everything (speed baseline).
+
+``initialize`` wires a model-apply function, a fused optimizer, and a
+``LossScaler`` into an :class:`AmpState` — the functional equivalent of
+apex's patched (model, optimizer) pair.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.interpreter import autocast
+from apex_tpu.amp.scaler import LossScaler, LossScaleState
+
+_BN_PATTERN = re.compile(
+    r"(batch_?norm|bn|layer_?norm|ln|group_?norm|rms_?norm|norm)",
+    re.IGNORECASE)
+
+
+class Properties:
+    """Resolved opt-level properties (apex ``frontend.py::Properties``)."""
+
+    def __init__(self, **kw):
+        self.opt_level = kw.get("opt_level")
+        self.cast_model_type = kw.get("cast_model_type")
+        self.patch_torch_functions = kw.get("patch_torch_functions", False)
+        self.keep_batchnorm_fp32 = kw.get("keep_batchnorm_fp32")
+        self.master_weights = kw.get("master_weights", False)
+        self.loss_scale = kw.get("loss_scale", 1.0)
+
+    def _asdict(self):
+        return dict(opt_level=self.opt_level,
+                    cast_model_type=self.cast_model_type,
+                    patch_torch_functions=self.patch_torch_functions,
+                    keep_batchnorm_fp32=self.keep_batchnorm_fp32,
+                    master_weights=self.master_weights,
+                    loss_scale=self.loss_scale)
+
+
+def _opt_level_properties(opt_level: str, half_dtype) -> Properties:
+    # bf16 needs no loss scaling (8-bit exponent = f32 range); fp16 does.
+    dyn = "dynamic" if half_dtype == jnp.float16 else 1.0
+    table = {
+        "O0": Properties(opt_level="O0", cast_model_type=jnp.float32,
+                         patch_torch_functions=False,
+                         keep_batchnorm_fp32=None, master_weights=False,
+                         loss_scale=1.0),
+        "O1": Properties(opt_level="O1", cast_model_type=None,
+                         patch_torch_functions=True,
+                         keep_batchnorm_fp32=None, master_weights=False,
+                         loss_scale=dyn),
+        "O2": Properties(opt_level="O2", cast_model_type=half_dtype,
+                         patch_torch_functions=False,
+                         keep_batchnorm_fp32=True, master_weights=True,
+                         loss_scale=dyn),
+        "O3": Properties(opt_level="O3", cast_model_type=half_dtype,
+                         patch_torch_functions=False,
+                         keep_batchnorm_fp32=False, master_weights=False,
+                         loss_scale=1.0),
+    }
+    if opt_level not in table:
+        raise ValueError(f"Unexpected optimization level {opt_level}; "
+                         "options are 'O0', 'O1', 'O2', 'O3'.")
+    return table[opt_level]
+
+
+def _is_norm_param(path_str: str) -> bool:
+    return bool(_BN_PATTERN.search(path_str))
+
+
+class AmpState(NamedTuple):
+    """Everything ``amp.initialize`` wires together (functional form)."""
+
+    apply_fn: Callable          # policy-wrapped model apply
+    optimizer: Any              # the (possibly master-weight) fused optimizer
+    scaler: LossScaler
+    properties: Properties
+
+    def cast_params(self, params):
+        """Apply the opt level's model-weight cast (O2/O3)."""
+        dtype = self.properties.cast_model_type
+        if dtype is None or dtype == jnp.float32:
+            return params
+        keep_bn = self.properties.keep_batchnorm_fp32
+
+        def cast(path, x):
+            if not jnp.issubdtype(x.dtype, jnp.floating):
+                return x
+            if keep_bn and _is_norm_param(jax.tree_util.keystr(path)):
+                return x.astype(jnp.float32)
+            return x.astype(dtype)
+
+        return jax.tree_util.tree_map_with_path(cast, params)
+
+    def cast_inputs(self, *args):
+        dtype = self.properties.cast_model_type
+        if dtype is None or dtype == jnp.float32:
+            return args
+        cast = lambda x: (x.astype(dtype)
+                          if hasattr(x, "dtype") and
+                          jnp.issubdtype(x.dtype, jnp.floating) else x)
+        return jax.tree_util.tree_map(cast, args)
+
+
+def initialize(model_apply: Callable, optimizer=None, opt_level: str = "O1",
+               half_dtype=jnp.bfloat16, cast_model_type=None,
+               patch_torch_functions=None, keep_batchnorm_fp32=None,
+               master_weights=None, loss_scale=None,
+               min_loss_scale=None, max_loss_scale=2.0 ** 24,
+               verbosity=1, **unused):
+    """TPU translation of ``apex.amp.initialize(model, optimizer, ...)``.
+
+    ``model_apply`` is the functional model: ``apply(params, *inputs)``.
+    Returns an :class:`AmpState`; use ``state.apply_fn`` in place of the
+    model, ``state.cast_params`` once on init (O2/O3), and the
+    ``scale_loss``/``unscale_step`` helpers from ``apex_tpu.amp`` in the
+    train loop.  Property overrides mirror apex's keyword overrides.
+    """
+    props = _opt_level_properties(opt_level, half_dtype)
+    for name, val in dict(cast_model_type=cast_model_type,
+                          patch_torch_functions=patch_torch_functions,
+                          keep_batchnorm_fp32=keep_batchnorm_fp32,
+                          master_weights=master_weights,
+                          loss_scale=loss_scale).items():
+        if val is not None:
+            setattr(props, name, val)
+
+    if props.patch_torch_functions:
+        apply_fn = autocast(model_apply, compute_dtype=half_dtype)
+    else:
+        apply_fn = model_apply
+
+    if optimizer is not None and props.master_weights:
+        optimizer.master_weights = True
+
+    scaler = LossScaler(loss_scale=props.loss_scale,
+                        min_loss_scale=min_loss_scale,
+                        max_loss_scale=max_loss_scale)
+    return AmpState(apply_fn, optimizer, scaler, props)

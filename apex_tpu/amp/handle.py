@@ -1,0 +1,50 @@
+"""Train-loop helpers — TPU translation of ``apex/amp/handle.py``.
+
+Apex's ``with amp.scale_loss(loss, optimizer) as scaled: scaled.backward()``
+doesn't map onto functional autodiff, so the same contract is split into
+composable pieces that live inside the jitted train step:
+
+* :func:`scale_loss` — multiply the loss by the current scale (inside the
+  loss function, before ``jax.grad``).
+* :func:`unscale_step` — the whole post-backward sequence fused: overflow
+  check on the *scaled* grads, optimizer step with ``grad_scale=1/scale``
+  (unscaling fused into the update kernel) skipped on-device when overflow,
+  then dynamic scale adjustment.  This is apex §3.2's hot path with zero
+  host syncs.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from apex_tpu.amp.scaler import LossScaler, LossScaleState
+
+
+def scale_loss(loss, scaler_state: LossScaleState):
+    """Scale the loss (use inside the loss fn, pre-``jax.grad``)."""
+    return loss * scaler_state.loss_scale.astype(loss.dtype)
+
+
+def unscale_step(optimizer, grads, params, opt_state,
+                 scaler: LossScaler, scaler_state: LossScaleState, *,
+                 lr=None):
+    """Fused unscale + overflow-skip + optimizer step + scale update.
+
+    Returns ``(new_params, new_opt_state, new_scaler_state, found_inf)``.
+
+    With a static scaler (the bf16 default) the overflow check is skipped
+    entirely — no isfinite pass, no noop select — matching apex, which only
+    pays the check under dynamic scaling.
+    """
+    if scaler.dynamic:
+        finf = LossScaler.found_inf(grads)
+        noop = finf.astype(jnp.int32)
+    else:
+        finf = jnp.zeros((), jnp.float32)
+        noop = None
+    inv_scale = 1.0 / scaler_state.loss_scale
+    new_params, new_opt_state = optimizer.step(
+        grads, params, opt_state, lr=lr, grad_scale=inv_scale,
+        noop_flag=noop)
+    new_scaler_state = scaler.update(scaler_state, finf)
+    return new_params, new_opt_state, new_scaler_state, finf

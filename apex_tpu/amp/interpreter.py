@@ -1,0 +1,149 @@
+"""O1 autocast as a jaxpr interpreter.
+
+Apex implements opt-level O1 by monkey-patching the torch functional surface
+with cast-inserting wrappers (``apex/amp/wrap.py`` + ``apex/amp/utils.py``).
+JAX has no mutable op registry, so the same *semantics* — MXU-bound ops run
+in low precision, precision-sensitive ops run in f32, multi-arg ops promote
+to the widest dtype — are reproduced by re-interpreting the traced jaxpr and
+inserting casts per primitive.  Because the interpretation happens inside
+the user's trace, ``jax.grad``/``jax.jit`` compose: the backward pass
+differentiates through the inserted casts exactly as torch autograd does for
+apex's forward-inserted casts.
+
+Higher-order primitives: ``pjit``/``closed_call``/``remat`` bodies are
+recursed into; control-flow and custom-derivative calls
+(``scan``/``while``/``cond``/``custom_jvp_call``/``custom_vjp_call``) are
+left intact with their inputs restored to the traced dtypes — casting across
+a loop-carry boundary would change carry dtypes mid-loop.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.extend import core as jex_core
+
+
+def _safe_map(f, *xs):
+    lists = [list(x) for x in xs]
+    assert all(len(l) == len(lists[0]) for l in lists)
+    return list(map(f, *lists))
+
+from apex_tpu.amp.lists import classify
+
+_RECURSE = {"pjit", "jit", "closed_call", "core_call", "remat", "remat2",
+            "checkpoint"}
+# custom-derivative calls can't be re-bound from their eqn params (the
+# callables aren't serialized there) — inline their call_jaxpr instead.
+# The custom rule is lost under the interpreter; standard autodiff of the
+# inlined body applies, which matches apex O1 (patched ops are plain ops).
+_INLINE_CALL = {"custom_jvp_call", "custom_vjp_call",
+                "custom_jvp_call_jaxpr", "custom_vjp_call_jaxpr",
+                "custom_jvp_generic_call", "custom_lin"}
+_RESTORE_DTYPES = {"scan", "while", "cond"}
+
+
+def _is_float(x) -> bool:
+    return jnp.issubdtype(jnp.result_type(x), jnp.floating)
+
+
+def _cast(x, dtype):
+    if _is_float(x) and jnp.result_type(x) != dtype:
+        return jax.lax.convert_element_type(x, dtype)
+    return x
+
+
+def _widest(vals):
+    dts = [jnp.result_type(v) for v in vals if _is_float(v)]
+    if not dts:
+        return None
+    return functools.reduce(jnp.promote_types, dts)
+
+
+def _eval_jaxpr(jaxpr, consts, args, compute_dtype):
+    env = {}
+
+    def read(var):
+        if isinstance(var, jex_core.Literal):
+            return var.val
+        return env[var]
+
+    def write(var, val):
+        env[var] = val
+
+    _safe_map(write, jaxpr.constvars, consts)
+    _safe_map(write, jaxpr.invars, args)
+
+    for eqn in jaxpr.eqns:
+        invals = _safe_map(read, eqn.invars)
+        name = eqn.primitive.name
+        params = eqn.params
+        if name in _INLINE_CALL and "call_jaxpr" in params:
+            inner = params["call_jaxpr"]
+            inner_jaxpr = inner.jaxpr if hasattr(inner, "jaxpr") else inner
+            inner_consts = inner.consts if hasattr(inner, "consts") else ()
+            invals = [_cast(v, var.aval.dtype) if _is_float(v) else v
+                      for v, var in zip(invals, inner_jaxpr.invars)]
+            outvals = _eval_jaxpr(inner_jaxpr, inner_consts, invals,
+                                  compute_dtype)
+        elif name in _RECURSE and "jaxpr" in eqn.params:
+            inner = eqn.params["jaxpr"]
+            inner_jaxpr = inner.jaxpr if hasattr(inner, "jaxpr") else inner
+            inner_consts = (inner.consts if hasattr(inner, "consts")
+                            else eqn.params.get("consts", ()))
+            # dtype alignment at the call boundary: sub-jaxpr invars were
+            # traced at specific dtypes
+            invals = [_cast(v, var.aval.dtype) if _is_float(v) else v
+                      for v, var in zip(invals, inner_jaxpr.invars)]
+            outvals = _eval_jaxpr(inner_jaxpr, inner_consts, invals,
+                                  compute_dtype)
+        else:
+            if name in _RESTORE_DTYPES:
+                invals = [_cast(v, var.aval.dtype) if _is_float(v) else v
+                          for v, var in zip(invals, eqn.invars)]
+            else:
+                kind = classify(eqn.primitive)
+                if kind == "whitelist" and all(map(_is_float, invals)):
+                    invals = [_cast(v, compute_dtype) for v in invals]
+                    # tracing with f32 inputs bakes preferred_element_type=
+                    # f32 into dot/conv params; O1 semantics want half out.
+                    # (Integer/quantized dots fall through untouched.)
+                    pet = params.get("preferred_element_type")
+                    if pet is not None and jnp.issubdtype(pet, jnp.floating):
+                        params = dict(params,
+                                      preferred_element_type=compute_dtype)
+                elif kind == "blacklist":
+                    invals = [_cast(v, jnp.float32) for v in invals]
+                elif kind == "promote":
+                    wide = _widest(invals)
+                    if wide is not None:
+                        invals = [_cast(v, wide) for v in invals]
+            outvals = eqn.primitive.bind(*invals, **params)
+        if not eqn.primitive.multiple_results:
+            outvals = [outvals]
+        _safe_map(write, eqn.outvars, outvals)
+
+    return _safe_map(read, jaxpr.outvars)
+
+
+def autocast(fun, compute_dtype=jnp.bfloat16):
+    """Wrap ``fun`` so each primitive runs at its O1-classified precision.
+
+    The returned function has the same signature; outputs keep their traced
+    output dtypes EXCEPT where the final op itself was reclassified (matmul
+    outputs become ``compute_dtype``), mirroring apex O1 where patched ops
+    return fp16 tensors.
+    """
+
+    @functools.wraps(fun)
+    def wrapped(*args, **kwargs):
+        closed, out_shape = jax.make_jaxpr(
+            functools.partial(fun, **kwargs), return_shape=True)(*args)
+        flat, _ = jax.tree_util.tree_flatten(args)
+        out_tree = jax.tree_util.tree_structure(out_shape)
+        outs = _eval_jaxpr(closed.jaxpr, closed.consts, flat, compute_dtype)
+        return jax.tree_util.tree_unflatten(out_tree, outs)
+
+    return wrapped

@@ -1,0 +1,81 @@
+"""O1 per-op cast lists — TPU rebuild of ``apex/amp/lists/*.py``.
+
+Apex classifies the torch functional surface into FP16-whitelist (tensor-core
+ops), FP32-blacklist (precision-sensitive ops), and promote (multi-arg ops
+take the widest dtype).  The JAX equivalent classifies *primitives* in the
+traced jaxpr — same semantics, no monkey-patching.
+"""
+
+from __future__ import annotations
+
+import jax
+
+# MXU-bound ops: cast inputs to the low-precision compute dtype
+# (apex/amp/lists/functional_overrides.py FP16_FUNCS: conv*, linear, matmul,
+# addmm, bmm, ...)
+WHITELIST = {
+    "dot_general",
+    "conv_general_dilated",
+}
+
+# Precision-sensitive ops: force f32 inputs
+# (apex FP32_FUNCS: softmax, log_softmax, exp, expm1, log, log1p, pow,
+# sum/mean-style reductions, norm, cross-entropy, ...)
+BLACKLIST = {
+    "exp",
+    "exp2",
+    "expm1",
+    "log",
+    "log1p",
+    "pow",
+    "integer_pow",
+    "logistic",
+    "erf",
+    "erfc",
+    "erf_inv",
+    "rsqrt",
+    "reduce_sum",
+    "reduce_prod",
+    "cumsum",
+    "cumprod",
+    "cumlogsumexp",
+    "reduce_precision",
+    "lgamma",
+    "digamma",
+    "acos",
+    "asin",
+    "atan",
+    "atan2",
+    "cosh",
+    "sinh",
+    "asinh",
+    "acosh",
+    "atanh",
+}
+
+# Multi-arg elementwise ops promote to the widest floating dtype present
+# (apex CASTS/promote list: add, mul, cat, where, ...)
+PROMOTE = {
+    "add",
+    "sub",
+    "mul",
+    "div",
+    "max",
+    "min",
+    "rem",
+    "nextafter",
+    "concatenate",
+    "select_n",
+    "atan2",
+}
+
+
+def classify(primitive: jax.extend.core.Primitive) -> str:
+    name = primitive.name
+    if name in WHITELIST:
+        return "whitelist"
+    if name in BLACKLIST:
+        return "blacklist"
+    if name in PROMOTE:
+        return "promote"
+    return "passthrough"

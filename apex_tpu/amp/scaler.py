@@ -1,0 +1,104 @@
+"""Loss scaling — TPU rebuild of ``apex/amp/scaler.py::LossScaler``.
+
+Functional: the scaler's mutable fields (current scale, unskipped-step
+counter) live in an explicit state pytree so the whole train step stays
+jittable.  Overflow detection fuses into the multi-tensor unscale pass
+(apex: ``amp_C.multi_tensor_scale`` writing the ``overflow_buf``), and the
+skip decision is carried as an on-device ``noop`` flag — no host sync.
+
+bf16 on TPU rarely overflows, so the default scale for bf16 policies is the
+static 1.0 (machinery intact for fp16-parity and for users who want it).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.multi_tensor_apply import multi_tensor_scale
+
+_f32 = jnp.float32
+
+
+class LossScaleState(NamedTuple):
+    loss_scale: jax.Array        # f32 scalar
+    unskipped: jax.Array         # int32 — clean steps since last growth
+    overflows: jax.Array         # int32 — total overflow count (diagnostics)
+
+
+class LossScaler:
+    """``loss_scale``: a number for static scaling or ``"dynamic"``."""
+
+    def __init__(self, loss_scale="dynamic", init_scale=2.0 ** 16,
+                 scale_factor=2.0, scale_window=2000, min_loss_scale=None,
+                 max_loss_scale=2.0 ** 24):
+        self.dynamic = loss_scale == "dynamic"
+        self._init_scale = float(init_scale if self.dynamic else loss_scale)
+        self.scale_factor = float(scale_factor)
+        self.scale_window = int(scale_window)
+        self.min_loss_scale = (None if min_loss_scale is None
+                               else float(min_loss_scale))
+        self.max_loss_scale = float(max_loss_scale)
+
+    def init(self) -> LossScaleState:
+        return LossScaleState(jnp.asarray(self._init_scale, _f32),
+                              jnp.zeros((), jnp.int32),
+                              jnp.zeros((), jnp.int32))
+
+    def scale(self, loss, state: LossScaleState):
+        """Multiply the loss (apex: ``scale_loss`` context entry)."""
+        return loss * state.loss_scale.astype(loss.dtype)
+
+    def unscale(self, grads, state: LossScaleState):
+        """Unscale gradients with fused overflow detection.
+
+        Returns ``(unscaled_grads, found_inf)`` — the functional analogue of
+        apex's unscale-with-overflow-buffer.  Prefer passing
+        ``grad_scale=1/scale`` straight to a fused optimizer instead (saves
+        a pass over the gradients); use :meth:`found_inf` for the check.
+        """
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        outs, finf = multi_tensor_scale(leaves, 1.0 / state.loss_scale)
+        return jax.tree_util.tree_unflatten(treedef, outs), finf
+
+    @staticmethod
+    def found_inf(grads) -> jax.Array:
+        """f32 0/1 flag: any non-finite value in the gradient pytree."""
+        leaves = jax.tree_util.tree_leaves(grads)
+        bad = jnp.zeros((), jnp.bool_)
+        for g in leaves:
+            bad = bad | jnp.logical_not(jnp.all(jnp.isfinite(g)))
+        return bad.astype(_f32)
+
+    def update(self, state: LossScaleState, found_inf) -> LossScaleState:
+        """Post-step scale adjustment (apex ``update_scale``): halve on
+        overflow, double every ``scale_window`` clean steps."""
+        if not self.dynamic:
+            return state
+        overflow = jnp.asarray(found_inf) > 0
+        new_scale = jnp.where(overflow,
+                              state.loss_scale / self.scale_factor,
+                              state.loss_scale)
+        if self.min_loss_scale is not None:
+            new_scale = jnp.maximum(new_scale, self.min_loss_scale)
+        unskipped = jnp.where(overflow, 0, state.unskipped + 1)
+        grow = unskipped >= self.scale_window
+        new_scale = jnp.where(
+            grow, jnp.minimum(new_scale * self.scale_factor,
+                              self.max_loss_scale), new_scale)
+        unskipped = jnp.where(grow, 0, unskipped)
+        return LossScaleState(new_scale, unskipped,
+                              state.overflows + overflow.astype(jnp.int32))
+
+    # apex checkpoint surface (tests/L0/run_amp/test_checkpointing.py)
+    def state_dict(self, state: LossScaleState) -> dict:
+        return {"loss_scale": float(state.loss_scale),
+                "unskipped": int(state.unskipped),
+                "overflows": int(state.overflows)}
+
+    def load_state_dict(self, d: dict) -> LossScaleState:
+        return LossScaleState(jnp.asarray(d["loss_scale"], _f32),
+                              jnp.asarray(d["unskipped"], jnp.int32),
+                              jnp.asarray(d.get("overflows", 0), jnp.int32))

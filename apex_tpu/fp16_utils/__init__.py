@@ -1,0 +1,127 @@
+"""fp16_utils — TPU rebuild of the legacy ``apex/fp16_utils`` package.
+
+Pre-amp static mixed precision: manual half casts + fp32 master params +
+(dynamic) loss scaling.  On TPU the half type defaults to bf16.  The modern
+path is ``apex_tpu.amp``; this module keeps the legacy surface
+(``network_to_half``, ``prep_param_lists``, ``master_params_to_model_params``,
+``FP16_Optimizer``) for recipes written against it.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.scaler import LossScaler as _AmpLossScaler
+from apex_tpu.amp.frontend import _is_norm_param
+
+__all__ = [
+    "network_to_half",
+    "BN_convert_float",
+    "prep_param_lists",
+    "master_params_to_model_params",
+    "model_grads_to_master_grads",
+    "FP16_Optimizer",
+    "LossScaler",
+    "DynamicLossScaler",
+]
+
+
+def network_to_half(params, half_dtype=jnp.bfloat16):
+    """Cast float params to half, keeping normalization params fp32
+    (reference: ``apex/fp16_utils/fp16util.py::network_to_half`` +
+    ``BN_convert_float``)."""
+    def cast(path, x):
+        if not jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
+            return x
+        if _is_norm_param(jax.tree_util.keystr(path)):
+            return jnp.asarray(x, jnp.float32)
+        return jnp.asarray(x, half_dtype)
+    return jax.tree_util.tree_map_with_path(cast, params)
+
+
+def BN_convert_float(params):
+    """Force normalization params back to fp32."""
+    def cast(path, x):
+        if (jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+                and _is_norm_param(jax.tree_util.keystr(path))):
+            return jnp.asarray(x, jnp.float32)
+        return x
+    return jax.tree_util.tree_map_with_path(cast, params)
+
+
+def prep_param_lists(params):
+    """Return ``(model_params, master_params)`` — fp32 master copies
+    (reference: ``fp16util.py::prep_param_lists``; the flat-buffer variant is
+    what the packed optimizer state already does)."""
+    master = jax.tree_util.tree_map(
+        lambda x: (jnp.asarray(x, jnp.float32)
+                   if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+                   else x), params)
+    return params, master
+
+
+def master_params_to_model_params(model_params, master_params):
+    """Copy master values into the model-precision pytree."""
+    return jax.tree_util.tree_map(
+        lambda mp, m: m.astype(mp.dtype), model_params, master_params)
+
+
+def model_grads_to_master_grads(model_grads):
+    """Upcast model-precision grads to fp32 master grads."""
+    return jax.tree_util.tree_map(
+        lambda g: g.astype(jnp.float32), model_grads)
+
+
+LossScaler = _AmpLossScaler
+
+
+class DynamicLossScaler(_AmpLossScaler):
+    """Legacy alias: always-dynamic scaler
+    (reference: ``apex/fp16_utils/loss_scaler.py::DynamicLossScaler``)."""
+
+    def __init__(self, init_scale=2.0 ** 32, scale_factor=2.0,
+                 scale_window=1000):
+        super().__init__("dynamic", init_scale=init_scale,
+                         scale_factor=scale_factor,
+                         scale_window=scale_window)
+
+
+class FP16_Optimizer:
+    """Legacy wrapper (reference: ``fp16_optimizer.py``): fused optimizer +
+    fp32 master weights + loss scaling in one object.
+
+    Functional usage::
+
+        opt = FP16_Optimizer(FusedAdam(lr=1e-3), dynamic_loss_scale=True)
+        state = opt.init(params)            # master copies + scaler state
+        params, state = opt.step(grads, params, state)
+    """
+
+    def __init__(self, init_optimizer, static_loss_scale=1.0,
+                 dynamic_loss_scale=False, dynamic_loss_args=None,
+                 verbose=False):
+        self.optimizer = init_optimizer
+        self.optimizer.master_weights = True
+        args = dynamic_loss_args or {}
+        self.loss_scaler = (_AmpLossScaler("dynamic", **args)
+                            if dynamic_loss_scale
+                            else _AmpLossScaler(static_loss_scale))
+
+    def init(self, params):
+        return {"optimizer": self.optimizer.init(params),
+                "loss_scaler": self.loss_scaler.init()}
+
+    def scale_loss(self, loss, state):
+        return self.loss_scaler.scale(loss, state["loss_scaler"])
+
+    def step(self, grads, params, state, lr=None):
+        sstate = state["loss_scaler"]
+        finf = _AmpLossScaler.found_inf(grads)
+        new_params, new_opt = self.optimizer.step(
+            grads, params, state["optimizer"], lr=lr,
+            grad_scale=1.0 / sstate.loss_scale,
+            noop_flag=finf.astype(jnp.int32))
+        return new_params, {
+            "optimizer": new_opt,
+            "loss_scaler": self.loss_scaler.update(sstate, finf)}

@@ -327,14 +327,16 @@ def sgd_packed(g, p, mom, *, lr, weight_decay, momentum, dampening,
 def _lamb_stage1_math(adam_w_mode, scal, skip, g, p, m, v):
     """Pure f32 LAMB stage-1: moments + raw update + row sums of u², p².
 
-    scal: [beta1, beta2, eps, wd, bc1, bc2, grad_scale, clip]
+    scal: [beta1, beta2, eps, wd, bc1, bc2, grad_scale, clip, beta3]
+    (beta3 = 1-beta1 with grad averaging — apex's ``grad_averaging`` — or
+    1.0 without.)
     """
-    beta1, beta2, eps, wd, bc1, bc2, gscale, clip = (scal[k]
-                                                     for k in range(8))
+    beta1, beta2, eps, wd, bc1, bc2, gscale, clip, beta3 = (
+        scal[k] for k in range(9))
     g = g * gscale * clip
     if not adam_w_mode:
         g = g + wd * p
-    m_new = beta1 * m + (1.0 - beta1) * g
+    m_new = beta1 * m + beta3 * g
     v_new = beta2 * v + (1.0 - beta2) * g * g
     u = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
     if adam_w_mode:
@@ -363,17 +365,19 @@ def _lamb_stage1_kernel(adam_w_mode, scal_ref, noop_ref,
 
 def lamb_stage1_packed(g, p, m, v, *, beta1, beta2, eps, weight_decay,
                        bias_correction1, bias_correction2, grad_scale=1.0,
-                       global_grad_clip=1.0, adam_w_mode=True,
-                       noop_flag=None, block_rows: int):
+                       global_grad_clip=1.0, grad_averaging=True,
+                       adam_w_mode=True, noop_flag=None, block_rows: int):
     """LAMB stage 1: moments + raw update + per-row ‖u‖², ‖p‖² sums.
 
     Returns ``(u, m, v, u_rowsq, p_rowsq)``.  ``global_grad_clip``
     pre-multiplies gradients (apex folds global-norm clipping into the
     kernel the same way).
     """
+    beta3 = 1.0 - beta1 if grad_averaging else 1.0
     scal = jnp.stack([jnp.asarray(s, _f32) for s in
                       (beta1, beta2, eps, weight_decay, bias_correction1,
-                       bias_correction2, grad_scale, global_grad_clip)])
+                       bias_correction2, grad_scale, global_grad_clip,
+                       beta3)])
     noop = _as_noop(noop_flag)
     if not _use_kernel(g, p, m, v):
         u, m_new, v_new, usq, psq = _lamb_stage1_math(
@@ -473,24 +477,30 @@ def adagrad_packed(g, p, h, *, lr, eps, weight_decay, grad_scale=1.0,
 # novograd  (csrc/multi_tensor_novograd.cu)
 # ---------------------------------------------------------------------------
 
-def _novograd_math(scal, skip, g, p, m, v_row):
+def _novograd_math(reg_inside_moment, scal, skip, g, p, m, v_row):
     """Pure f32 NovoGrad elementwise stage.
 
-    scal: [lr, beta1, weight_decay, eps, grad_scale]; ``v_row`` is the
-    per-tensor second moment broadcast per row.
+    scal: [lr, beta1, weight_decay, eps, grad_scale, beta3]; ``v_row`` is
+    the per-tensor second moment broadcast per row.  ``reg_inside_moment``
+    (apex flag) selects whether weight decay feeds the momentum (True) or is
+    applied outside it at the param update (False, apex default).
     """
-    lr, beta1, wd, eps, gscale = (scal[k] for k in range(5))
+    lr, beta1, wd, eps, gscale, beta3 = (scal[k] for k in range(6))
     g = g * gscale
-    g = g / (jnp.sqrt(v_row) + eps) + wd * p
-    m_new = beta1 * m + g
-    p_new = p - lr * m_new
+    g = g / (jnp.sqrt(v_row) + eps)
+    if reg_inside_moment:
+        g = g + wd * p
+    m_new = beta1 * m + beta3 * g
+    update = m_new if reg_inside_moment else m_new + wd * p
+    p_new = p - lr * update
     return jnp.where(skip, p, p_new), jnp.where(skip, m, m_new)
 
 
-def _novograd_kernel(scal_ref, noop_ref, g_ref, p_ref, m_ref, vrow_ref,
-                     p_out, m_out):
+def _novograd_kernel(reg_inside_moment, scal_ref, noop_ref, g_ref, p_ref,
+                     m_ref, vrow_ref, p_out, m_out):
     skip = noop_ref[0] != 0
-    p_new, m_new = _novograd_math(scal_ref, skip, g_ref[:].astype(_f32),
+    p_new, m_new = _novograd_math(reg_inside_moment, scal_ref, skip,
+                                  g_ref[:].astype(_f32),
                                   p_ref[:].astype(_f32),
                                   m_ref[:].astype(_f32), vrow_ref[:])
     p_out[:] = p_new.astype(p_out.dtype)
@@ -498,19 +508,23 @@ def _novograd_kernel(scal_ref, noop_ref, g_ref, p_ref, m_ref, vrow_ref,
 
 
 def novograd_packed(g, p, m, v_row, *, lr, beta1, weight_decay, eps,
-                    grad_scale=1.0, noop_flag=None, block_rows: int):
+                    grad_scale=1.0, grad_averaging=False,
+                    reg_inside_moment=False, noop_flag=None,
+                    block_rows: int):
     """NovoGrad elementwise stage: per-tensor second moment ``v`` (already
     updated by the caller from per-tensor grad norms) is broadcast per row
     via ``v_row``; returns ``(p, m)``."""
+    beta3 = 1.0 - beta1 if grad_averaging else 1.0
     scal = jnp.stack([jnp.asarray(s, _f32) for s in
-                      (lr, beta1, weight_decay, eps, grad_scale)])
+                      (lr, beta1, weight_decay, eps, grad_scale, beta3)])
     noop = _as_noop(noop_flag)
     if not _use_kernel(g, p, m):
-        p_new, m_new = _novograd_math(scal, noop[0] != 0, g.astype(_f32),
+        p_new, m_new = _novograd_math(bool(reg_inside_moment), scal,
+                                      noop[0] != 0, g.astype(_f32),
                                       p.astype(_f32), m.astype(_f32), v_row)
         return p_new.astype(p.dtype), m_new.astype(m.dtype)
     return pl.pallas_call(
-        _novograd_kernel,
+        functools.partial(_novograd_kernel, bool(reg_inside_moment)),
         grid=_grid(p.shape[0], block_rows),
         in_specs=[_smem(), _smem()] + [_block(block_rows)] * 3
                  + [_rowsum_block(block_rows)],

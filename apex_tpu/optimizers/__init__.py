@@ -1,0 +1,16 @@
+from apex_tpu.optimizers.base import FusedOptimizer
+from apex_tpu.optimizers.fused_adam import FusedAdam
+from apex_tpu.optimizers.fused_sgd import FusedSGD
+from apex_tpu.optimizers.fused_lamb import FusedLAMB, FusedMixedPrecisionLamb
+from apex_tpu.optimizers.fused_novograd import FusedNovoGrad
+from apex_tpu.optimizers.fused_adagrad import FusedAdagrad
+
+__all__ = [
+    "FusedOptimizer",
+    "FusedAdam",
+    "FusedSGD",
+    "FusedLAMB",
+    "FusedMixedPrecisionLamb",
+    "FusedNovoGrad",
+    "FusedAdagrad",
+]

@@ -1,0 +1,44 @@
+"""FusedAdagrad — TPU rebuild of ``apex/optimizers/fused_adagrad.py``.
+
+Plain Adagrad (``h += g²; p -= lr·g/(sqrt(h)+eps)``) with apex's
+``adagrad_w_mode`` decoupled weight decay option, one fused kernel per
+dtype bucket.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from apex_tpu.optimizers.base import FusedOptimizer
+from apex_tpu.ops import multi_tensor as K
+
+
+class FusedAdagrad(FusedOptimizer):
+    def __init__(self, params=None, lr=1e-2, eps=1e-10, weight_decay=0.0,
+                 set_grad_none=True, adagrad_w_mode=False, **kw):
+        del params, set_grad_none
+        super().__init__(lr, weight_decay=weight_decay, eps=eps,
+                         adagrad_w_mode=bool(adagrad_w_mode), **kw)
+
+    def _init_bucket(self, info):
+        return {"sum": jnp.zeros((info.meta.nrows, 128), jnp.float32)}
+
+    def _update_bucket(self, info, g, p, st, hyper, step_count, grad_scale,
+                       noop, extras):
+        if hyper["adagrad_w_mode"]:
+            # decoupled (apex adagrad_w_mode): p -= lr*(update + wd*p_old)
+            p_new, h_new = K.adagrad_packed(
+                g, p, st["sum"], lr=hyper["lr"], eps=hyper["eps"],
+                weight_decay=0.0, grad_scale=grad_scale, noop_flag=noop,
+                block_rows=self.block_rows)
+            decay = hyper["lr"] * hyper["weight_decay"]
+            p_new = (p_new.astype(jnp.float32)
+                     - decay * p.astype(jnp.float32)).astype(p_new.dtype)
+            if noop is not None:
+                p_new = jnp.where(noop != 0, p, p_new)
+        else:
+            p_new, h_new = K.adagrad_packed(
+                g, p, st["sum"], lr=hyper["lr"], eps=hyper["eps"],
+                weight_decay=hyper["weight_decay"], grad_scale=grad_scale,
+                noop_flag=noop, block_rows=self.block_rows)
+        return p_new, {"sum": h_new}

@@ -1,0 +1,57 @@
+"""FusedAdam — TPU rebuild of ``apex/optimizers/fused_adam.py``.
+
+Apex semantics preserved: ``adam_w_mode`` selects AdamW (decoupled decay,
+default) vs classic Adam (L2 in gradient); ``bias_correction`` toggles the
+``1-beta^t`` terms; one fused kernel launch per dtype bucket per step;
+``amsgrad`` unsupported (apex raises too).  ``capturable`` (CUDA-graph
+safety) is accepted for signature parity and ignored — every step here is
+XLA-compiled, which is the TPU analogue of graph capture.  The
+``master_weights`` variant keeps packed fp32 master params in optimizer
+state and casts down to the model dtype after each step (apex
+``master_weights=True``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from apex_tpu.optimizers.base import FusedOptimizer
+from apex_tpu.ops import multi_tensor as K
+
+
+class FusedAdam(FusedOptimizer):
+    def __init__(self, params=None, lr=1e-3, bias_correction=True,
+                 betas=(0.9, 0.999), eps=1e-8, adam_w_mode=True,
+                 weight_decay=0.0, amsgrad=False, set_grad_none=True,
+                 capturable=False, master_weights=False, **kw):
+        if amsgrad:
+            raise RuntimeError("FusedAdam does not support the AMSGrad "
+                               "variant.")  # apex parity
+        del params, set_grad_none, capturable  # signature parity only
+        super().__init__(lr, weight_decay=weight_decay,
+                         master_weights=master_weights,
+                         betas=tuple(betas), eps=eps,
+                         bias_correction=bool(bias_correction),
+                         adam_w_mode=bool(adam_w_mode), **kw)
+
+    def _init_bucket(self, info):
+        shape = (info.meta.nrows, 128)
+        return {"m": jnp.zeros(shape, jnp.float32),
+                "v": jnp.zeros(shape, jnp.float32)}
+
+    def _update_bucket(self, info, g, p, st, hyper, step_count, grad_scale,
+                       noop, extras):
+        beta1, beta2 = hyper["betas"]
+        if hyper["bias_correction"]:
+            t = step_count.astype(jnp.float32)
+            bc1 = 1.0 - beta1 ** t
+            bc2 = 1.0 - beta2 ** t
+        else:
+            bc1 = bc2 = 1.0
+        p_new, m_new, v_new = K.adam_packed(
+            g, p, st["m"], st["v"], lr=hyper["lr"], beta1=beta1, beta2=beta2,
+            eps=hyper["eps"], weight_decay=hyper["weight_decay"],
+            bias_correction1=bc1, bias_correction2=bc2,
+            grad_scale=grad_scale, adam_w_mode=hyper["adam_w_mode"],
+            noop_flag=noop, block_rows=self.block_rows)
+        return p_new, {"m": m_new, "v": v_new}

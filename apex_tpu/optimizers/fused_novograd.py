@@ -1,0 +1,72 @@
+"""FusedNovoGrad — TPU rebuild of ``apex/optimizers/fused_novograd.py``.
+
+NovoGrad keeps the second moment per *tensor* (one scalar per layer), not
+per element: ``v_t = beta2*v + (1-beta2)*||g||²`` (init ``v_0 = ||g||²``).
+Per-tensor grad norms come from the packed l2norm kernel + a segment-sum;
+the elementwise stage is one fused kernel with the per-tensor ``sqrt(v)``
+broadcast per row.  ``reg_inside_moment`` puts weight decay inside the
+moment (apex option); ``norm_type`` 2 only (apex also only implements 2).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from apex_tpu.optimizers.base import (FusedOptimizer, per_tensor_ratio_rows,
+                                      per_tensor_sums)
+from apex_tpu.ops import multi_tensor as K
+
+_f32 = jnp.float32
+
+
+class FusedNovoGrad(FusedOptimizer):
+    def __init__(self, params=None, lr=1e-3, bias_correction=True,
+                 betas=(0.95, 0.98), eps=1e-8, weight_decay=0.0,
+                 amsgrad=False, reg_inside_moment=False, grad_averaging=True,
+                 norm_type=2, init_zero=False, set_grad_none=True, **kw):
+        if amsgrad:
+            raise RuntimeError("FusedNovoGrad does not support the AMSGrad "
+                               "variant.")
+        if norm_type != 2:
+            raise RuntimeError("FusedNovoGrad only supports l2 norm.")
+        del params, set_grad_none
+        super().__init__(lr, weight_decay=weight_decay, betas=tuple(betas),
+                         eps=eps, bias_correction=bool(bias_correction),
+                         reg_inside_moment=bool(reg_inside_moment),
+                         grad_averaging=bool(grad_averaging),
+                         init_zero=bool(init_zero), **kw)
+
+    def _init_bucket(self, info):
+        n = len(info.meta.shapes)
+        return {"m": jnp.zeros((info.meta.nrows, 128), _f32),
+                "v": jnp.zeros((n,), _f32)}
+
+    def _update_bucket(self, info, g, p, st, hyper, step_count, grad_scale,
+                       noop, extras):
+        beta1, beta2 = hyper["betas"]
+        rowsq, _ = K.l2norm_rowsq_packed(g, block_rows=self.block_rows)
+        gnorm_sq = per_tensor_sums(info.meta, rowsq) * \
+            jnp.asarray(grad_scale, _f32) ** 2
+        if hyper["init_zero"]:
+            v_new = beta2 * st["v"] + (1.0 - beta2) * gnorm_sq
+        else:
+            # apex: v initialized to the first ||g||², not zero
+            v_new = jnp.where(step_count == 1, gnorm_sq,
+                              beta2 * st["v"] + (1.0 - beta2) * gnorm_sq)
+        if noop is not None:
+            v_new = jnp.where(noop != 0, st["v"], v_new)
+        # bias correction on the moment denominators (apex applies via lr)
+        if hyper["bias_correction"]:
+            t = step_count.astype(_f32)
+            lr_eff = hyper["lr"] * jnp.sqrt(1.0 - beta2 ** t) / \
+                (1.0 - beta1 ** t)
+        else:
+            lr_eff = hyper["lr"]
+        v_row = per_tensor_ratio_rows(info.meta, v_new)
+        p_new, m_new = K.novograd_packed(
+            g, p, st["m"], v_row, lr=lr_eff, beta1=beta1,
+            weight_decay=hyper["weight_decay"], eps=hyper["eps"],
+            grad_scale=grad_scale, grad_averaging=hyper["grad_averaging"],
+            reg_inside_moment=hyper["reg_inside_moment"],
+            noop_flag=noop, block_rows=self.block_rows)
+        return p_new, {"m": m_new, "v": v_new}

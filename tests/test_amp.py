@@ -1,0 +1,195 @@
+"""amp engine tests (apex ``tests/L0/run_amp`` analogue).
+
+Covers: O1 autocast primitive classification (basic casts + promotion),
+dynamic loss scaler dynamics, checkpoint round-trip, and the minimum
+end-to-end slice from SURVEY §7 — a 2-layer MLP trained to convergence with
+``amp.initialize`` + FusedAdam + loss scaling under one jit.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import amp
+from apex_tpu.optimizers import FusedAdam, FusedSGD
+
+
+class TestAutocastO1:
+    def test_matmul_runs_half(self):
+        # apex test_basic_casts: whitelist ops produce half outputs
+        def f(a, b):
+            return a @ b
+
+        fa = amp.autocast(f, compute_dtype=jnp.bfloat16)
+        a = jnp.ones((16, 16), jnp.float32)
+        out = fa(a, a)
+        assert out.dtype == jnp.bfloat16
+
+    def test_blacklist_runs_fp32(self):
+        def f(x):
+            return jnp.exp(x)
+
+        fa = amp.autocast(f, compute_dtype=jnp.bfloat16)
+        out = fa(jnp.ones((8, 8), jnp.bfloat16))
+        assert out.dtype == jnp.float32
+
+    def test_promotion_widest(self):
+        # apex test_promotion: mixed-dtype add promotes to the wider type
+        def f(a, b):
+            return a + b
+
+        fa = amp.autocast(f)
+        out = fa(jnp.ones((4,), jnp.bfloat16), jnp.ones((4,), jnp.float32))
+        assert out.dtype == jnp.float32
+
+    def test_grad_through_autocast(self):
+        def loss_fn(w, x):
+            h = x @ w                     # bf16 matmul under O1
+            return jnp.sum(jax.nn.softmax(h.astype(jnp.float32)))
+
+        fa = amp.autocast(loss_fn)
+        w = jnp.ones((8, 8), jnp.float32) * 0.1
+        x = jnp.ones((2, 8), jnp.float32)
+        g = jax.grad(lambda w: fa(w, x))(w)
+        assert g.dtype == jnp.float32
+        assert np.all(np.isfinite(np.asarray(g)))
+
+    def test_composite_network_numerics(self):
+        # autocast output should approximate the f32 reference
+        def net(params, x):
+            h = jnp.tanh(x @ params["w1"])
+            return jnp.sum(jax.nn.log_softmax(h @ params["w2"]))
+
+        rng = np.random.RandomState(0)
+        params = {"w1": jnp.asarray(rng.randn(16, 32).astype(np.float32)),
+                  "w2": jnp.asarray(rng.randn(32, 8).astype(np.float32))}
+        x = jnp.asarray(rng.randn(4, 16).astype(np.float32))
+        ref = net(params, x)
+        out = amp.autocast(net)(params, x)
+        np.testing.assert_allclose(float(out), float(ref), rtol=2e-2)
+
+    def test_jit_compose(self):
+        def f(a, b):
+            return a @ b
+
+        fa = jax.jit(amp.autocast(f))
+        out = fa(jnp.ones((8, 8)), jnp.ones((8, 8)))
+        assert out.dtype == jnp.bfloat16
+
+
+class TestLossScaler:
+    def test_dynamic_halves_on_overflow(self):
+        s = amp.LossScaler("dynamic", init_scale=2.0 ** 8)
+        st = s.init()
+        st2 = s.update(st, jnp.asarray(1.0))
+        assert float(st2.loss_scale) == 2.0 ** 7
+        assert int(st2.unskipped) == 0
+        assert int(st2.overflows) == 1
+
+    def test_dynamic_grows_after_window(self):
+        s = amp.LossScaler("dynamic", init_scale=4.0, scale_window=3)
+        st = s.init()
+        for _ in range(3):
+            st = s.update(st, jnp.asarray(0.0))
+        assert float(st.loss_scale) == 8.0
+        assert int(st.unskipped) == 0
+
+    def test_static_never_changes(self):
+        s = amp.LossScaler(128.0)
+        st = s.init()
+        st = s.update(st, jnp.asarray(1.0))
+        assert float(st.loss_scale) == 128.0
+
+    def test_found_inf(self):
+        g = {"a": jnp.ones((4,)), "b": jnp.asarray([1.0, np.inf])}
+        assert float(amp.LossScaler.found_inf(g)) == 1.0
+        g = {"a": jnp.ones((4,)), "b": jnp.asarray([1.0, 2.0])}
+        assert float(amp.LossScaler.found_inf(g)) == 0.0
+
+    def test_checkpoint_roundtrip(self):
+        # apex tests/L0/run_amp/test_checkpointing.py: amp state_dict survives
+        s = amp.LossScaler("dynamic", init_scale=2.0 ** 10)
+        st = s.update(s.init(), jnp.asarray(1.0))
+        d = s.state_dict(st)
+        st2 = s.load_state_dict(d)
+        assert float(st2.loss_scale) == float(st.loss_scale)
+        assert int(st2.unskipped) == int(st.unskipped)
+
+
+class TestEndToEndSlice:
+    """SURVEY §7 minimum slice: amp.initialize + FusedAdam + scale_loss,
+    2-layer MLP on synthetic data, trained to convergence under one jit."""
+
+    @pytest.mark.parametrize("opt_level", ["O0", "O1", "O2", "O3"])
+    def test_mlp_converges(self, opt_level, rng):
+        def apply_fn(params, x):
+            h = jax.nn.relu(x @ params["w1"] + params["b1"])
+            return h @ params["w2"] + params["b2"]
+
+        params = {
+            "w1": jnp.asarray(rng.randn(8, 32).astype(np.float32) * 0.3),
+            "b1": jnp.zeros((32,), jnp.float32),
+            "w2": jnp.asarray(rng.randn(32, 4).astype(np.float32) * 0.3),
+            "b2": jnp.zeros((4,), jnp.float32),
+        }
+        w_true = rng.randn(8, 4).astype(np.float32)
+        x = rng.randn(256, 8).astype(np.float32)
+        y = np.argmax(x @ w_true, axis=1)
+        x, y = jnp.asarray(x), jnp.asarray(y)
+
+        optimizer = FusedAdam(lr=5e-3)
+        state = amp.initialize(apply_fn, optimizer, opt_level=opt_level,
+                               half_dtype=jnp.bfloat16)
+        params = state.cast_params(params)
+        opt_state = optimizer.init(params)
+        scaler_state = state.scaler.init()
+
+        def loss_fn(params, x, y, scaler_state):
+            (x,) = state.cast_inputs(x)
+            logits = state.apply_fn(params, x).astype(jnp.float32)
+            loss = -jnp.mean(jax.nn.log_softmax(logits)[jnp.arange(len(y)),
+                                                        y])
+            return amp.scale_loss(loss, scaler_state), loss
+
+        @jax.jit
+        def train_step(params, opt_state, scaler_state, x, y):
+            grads, loss = jax.grad(loss_fn, has_aux=True)(
+                params, x, y, scaler_state)
+            params, opt_state, scaler_state, _ = amp.unscale_step(
+                optimizer, grads, params, opt_state, state.scaler,
+                scaler_state)
+            return params, opt_state, scaler_state, loss
+
+        losses = []
+        for i in range(150):
+            params, opt_state, scaler_state, loss = train_step(
+                params, opt_state, scaler_state, x, y)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.5, (opt_level, losses[::30])
+        # O2: params stayed half precision except none (no norm layers)
+        if opt_level in ("O2", "O3"):
+            assert params["w1"].dtype == jnp.bfloat16
+
+    def test_overflow_skip_then_recover(self, rng):
+        """Inject an inf gradient; the step must be skipped and the scale
+        halved (apex dynamic loss scaling semantics)."""
+        params = {"w": jnp.ones((16, 16), jnp.float32)}
+        optimizer = FusedAdam(lr=0.1)
+        opt_state = optimizer.init(params)
+        scaler = amp.LossScaler("dynamic", init_scale=2.0 ** 8)
+        sstate = scaler.init()
+        bad_grads = {"w": jnp.full((16, 16), np.inf, jnp.float32)}
+        p1, o1, s1, finf = amp.unscale_step(
+            optimizer, bad_grads, params, opt_state, scaler, sstate)
+        assert float(finf) == 1.0
+        np.testing.assert_array_equal(np.asarray(p1["w"]),
+                                      np.asarray(params["w"]))
+        assert float(s1.loss_scale) == 2.0 ** 7
+        assert int(o1["step"]) == 0
+        good = {"w": jnp.ones((16, 16), jnp.float32)}
+        p2, o2, s2, finf2 = amp.unscale_step(
+            optimizer, good, p1, o1, scaler, s1)
+        assert float(finf2) == 0.0
+        assert int(o2["step"]) == 1
+        assert not np.allclose(np.asarray(p2["w"]), np.asarray(p1["w"]))
