@@ -1,0 +1,97 @@
+"""FusedDense / FusedDenseGeluDense — TPU rebuild of
+``apex/fused_dense/fused_dense.py`` (+ ``csrc/fused_dense_cuda.cu``).
+
+Apex uses cuBLASLt epilogues (bias, gelu, dgelu+bgrad) to fuse the Linear(+
+GELU +Linear) chain.  XLA performs the same epilogue fusion on TPU (bias add
+and GELU fuse into the MXU matmul's output), so these are functional modules
+whose whole value is matching the apex module/`_function` surface.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "FusedDense",
+    "FusedDenseGeluDense",
+    "fused_dense_function",
+    "fused_dense_gelu_dense_function",
+]
+
+
+def fused_dense_function(x, weight, bias=None):
+    """``x @ W.T + b`` (apex ``fused_dense_function``)."""
+    y = x @ weight.T
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def fused_dense_gelu_dense_function(x, weight1, bias1, weight2, bias2):
+    """Linear→GELU→Linear in one fusion region (apex
+    ``fused_dense_gelu_dense_function``)."""
+    h = jax.nn.gelu(x @ weight1.T + bias1, approximate=True)
+    return h @ weight2.T + bias2
+
+
+class _DenseBase:
+    def _init_linear(self, key, out_f, in_f):
+        bound = 1.0 / jnp.sqrt(in_f)
+        k1, k2 = jax.random.split(key)
+        w = jax.random.uniform(k1, (out_f, in_f), minval=-bound,
+                               maxval=bound, dtype=jnp.float32)
+        b = jax.random.uniform(k2, (out_f,), minval=-bound, maxval=bound,
+                               dtype=jnp.float32)
+        return w.astype(self.param_dtype), b.astype(self.param_dtype)
+
+
+class FusedDense(_DenseBase):
+    """apex ``FusedDense(in_features, out_features, bias=True)``."""
+
+    def __init__(self, in_features, out_features, bias=True,
+                 param_dtype=jnp.float32):
+        self.in_features = int(in_features)
+        self.out_features = int(out_features)
+        self.bias = bool(bias)
+        self.param_dtype = param_dtype
+
+    def init_params(self, key):
+        w, b = self._init_linear(key, self.out_features, self.in_features)
+        return {"weight": w, "bias": b} if self.bias else {"weight": w}
+
+    def __call__(self, params, x):
+        return fused_dense_function(x, params["weight"],
+                                    params.get("bias"))
+
+    apply = __call__
+
+
+class FusedDenseGeluDense(_DenseBase):
+    """apex ``FusedDenseGeluDense(in, intermediate, out)``."""
+
+    def __init__(self, in_features, intermediate_features, out_features,
+                 bias=True, param_dtype=jnp.float32):
+        if not bias:
+            raise ValueError(
+                "FusedDenseGeluDense module without bias is currently not "
+                "supported")  # apex parity
+        self.in_features = int(in_features)
+        self.intermediate_features = int(intermediate_features)
+        self.out_features = int(out_features)
+        self.param_dtype = param_dtype
+
+    def init_params(self, key):
+        k1, k2 = jax.random.split(key)
+        w1, b1 = self._init_linear(k1, self.intermediate_features,
+                                   self.in_features)
+        w2, b2 = self._init_linear(k2, self.out_features,
+                                   self.intermediate_features)
+        return {"weight1": w1, "bias1": b1, "weight2": w2, "bias2": b2}
+
+    def __call__(self, params, x):
+        return fused_dense_gelu_dense_function(
+            x, params["weight1"], params["bias1"], params["weight2"],
+            params["bias2"])
+
+    apply = __call__

@@ -1,0 +1,23 @@
+from apex_tpu.normalization.fused_layer_norm import (
+    FusedLayerNorm,
+    FusedRMSNorm,
+    MixedFusedLayerNorm,
+    MixedFusedRMSNorm,
+)
+from apex_tpu.ops.layer_norm import (
+    fused_layer_norm_affine,
+    fused_layer_norm,
+    fused_rms_norm_affine,
+    fused_rms_norm,
+)
+
+__all__ = [
+    "FusedLayerNorm",
+    "FusedRMSNorm",
+    "MixedFusedLayerNorm",
+    "MixedFusedRMSNorm",
+    "fused_layer_norm_affine",
+    "fused_layer_norm",
+    "fused_rms_norm_affine",
+    "fused_rms_norm",
+]

@@ -1,0 +1,100 @@
+"""FusedLayerNorm / FusedRMSNorm modules — TPU rebuild of
+``apex/normalization/fused_layer_norm.py``.
+
+Modules are lightweight and functional (params are explicit pytrees):
+``m = FusedLayerNorm(hidden); params = m.init_params(); y = m(params, x)``.
+``MixedFused*`` keeps params fp32 with fp16/bf16 IO (apex's
+``MixedFusedLayerNorm``, used by ``apex/transformer/layers/layer_norm.py``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from apex_tpu.ops.layer_norm import (
+    fused_layer_norm_affine,
+    fused_layer_norm,
+    fused_rms_norm_affine,
+    fused_rms_norm,
+)
+
+__all__ = [
+    "FusedLayerNorm",
+    "FusedRMSNorm",
+    "MixedFusedLayerNorm",
+    "MixedFusedRMSNorm",
+]
+
+
+def _normalize_shape(normalized_shape):
+    if isinstance(normalized_shape, (int, np.integer)):
+        return (int(normalized_shape),)
+    return tuple(int(d) for d in normalized_shape)
+
+
+class FusedLayerNorm:
+    """Layer norm over the trailing ``normalized_shape`` dims.
+
+    Parity: ``apex.normalization.FusedLayerNorm(normalized_shape, eps,
+    elementwise_affine, memory_efficient)``.
+    """
+
+    rms = False
+
+    def __init__(self, normalized_shape, eps=1e-5, elementwise_affine=True,
+                 memory_efficient=False, param_dtype=jnp.float32):
+        self.normalized_shape = _normalize_shape(normalized_shape)
+        self.eps = float(eps)
+        self.elementwise_affine = bool(elementwise_affine)
+        self.memory_efficient = bool(memory_efficient)
+        self.param_dtype = param_dtype
+
+    def init_params(self):
+        if not self.elementwise_affine:
+            return {}
+        p = {"weight": jnp.ones(self.normalized_shape, self.param_dtype)}
+        if not self.rms:
+            p["bias"] = jnp.zeros(self.normalized_shape, self.param_dtype)
+        return p
+
+    def __call__(self, params, x):
+        if self.elementwise_affine:
+            if self.rms:
+                return fused_rms_norm_affine(
+                    x, params["weight"], self.normalized_shape, self.eps,
+                    self.memory_efficient)
+            return fused_layer_norm_affine(
+                x, params["weight"], params["bias"], self.normalized_shape,
+                self.eps, self.memory_efficient)
+        if self.rms:
+            return fused_rms_norm(x, self.normalized_shape, self.eps)
+        return fused_layer_norm(x, self.normalized_shape, self.eps)
+
+    apply = __call__
+
+
+class FusedRMSNorm(FusedLayerNorm):
+    """RMSNorm (no mean subtraction, no bias) — apex ``FusedRMSNorm``."""
+
+    rms = True
+
+
+class MixedFusedLayerNorm(FusedLayerNorm):
+    """fp32 params with low-precision IO (apex ``MixedFusedLayerNorm``)."""
+
+    def __init__(self, normalized_shape, eps=1e-5, **kwargs):
+        kwargs.pop("elementwise_affine", None)
+        super().__init__(normalized_shape, eps=eps, elementwise_affine=True,
+                         param_dtype=jnp.float32, **kwargs)
+
+    def __call__(self, params, x):
+        y = super().__call__(params, x)
+        return y.astype(x.dtype)
+
+    apply = __call__
+
+
+class MixedFusedRMSNorm(MixedFusedLayerNorm):
+    rms = True

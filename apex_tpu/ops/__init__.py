@@ -1,5 +1,5 @@
 """Low-level fused ops (Pallas kernels with jnp fallbacks)."""
 
-from apex_tpu.ops import multi_tensor
+from apex_tpu.ops import layer_norm, multi_tensor, rope, softmax, xentropy
 
-__all__ = ["multi_tensor"]
+__all__ = ["layer_norm", "multi_tensor", "rope", "softmax", "xentropy"]

@@ -1,0 +1,102 @@
+"""Fused rotary positional embedding — TPU rebuild of
+``csrc/megatron/fused_rotary_positional_embedding_cuda.cu`` +
+``apex/transformer/functional/fused_rope.py``.
+
+The rotate-half formulation is a pure VPU elementwise pattern that XLA fuses
+into adjacent ops; the custom_vjp mirrors the CUDA kernel's analytic
+backward (rotation by -θ) instead of differentiating through sin/cos, so
+``freqs`` never receives a gradient (apex treats it as non-differentiable).
+
+Layouts follow apex: ``sbhd`` — ``(seq, batch, head, dim)`` — is the
+default; ``thd`` (packed varlen with cu_seqlens) and the cached-sin/cos
+variant are provided.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_f32 = jnp.float32
+
+
+def _rotate_half(t):
+    d = t.shape[-1] // 2
+    t1, t2 = t[..., :d], t[..., d:]
+    return jnp.concatenate([-t2, t1], axis=-1)
+
+
+def _apply(t, cos, sin):
+    rot_dim = cos.shape[-1]
+    t_rot, t_pass = t[..., :rot_dim], t[..., rot_dim:]
+    out = t_rot.astype(_f32) * cos + _rotate_half(t_rot.astype(_f32)) * sin
+    out = out.astype(t.dtype)
+    if t_pass.shape[-1]:
+        out = jnp.concatenate([out, t_pass], axis=-1)
+    return out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=())
+def _rope_sbhd(t, cos, sin):
+    return _apply(t, cos, sin)
+
+
+def _rope_fwd(t, cos, sin):
+    return _apply(t, cos, sin), (cos, sin)
+
+
+def _rope_bwd(res, dy):
+    cos, sin = res
+    # y = t·cos + R(t)·sin with R = rotate-half ⇒ dt = dy·cos + Rᵀ(dy)·sin,
+    # Rᵀ([v1, v2]) = [v2, -v1] — the CUDA kernel's analytic backward.
+    rot_dim = cos.shape[-1]
+    dy_rot, dy_pass = dy[..., :rot_dim], dy[..., rot_dim:]
+    d = rot_dim // 2
+    dy1, dy2 = dy_rot[..., :d].astype(_f32), dy_rot[..., d:].astype(_f32)
+    rot_t = jnp.concatenate([dy2, -dy1], axis=-1)
+    dx = (dy_rot.astype(_f32) * cos + rot_t * sin).astype(dy.dtype)
+    if dy_pass.shape[-1]:
+        dx = jnp.concatenate([dx, dy_pass], axis=-1)
+    return dx, None, None
+
+
+_rope_sbhd.defvjp(_rope_fwd, _rope_bwd)
+
+
+def fused_apply_rotary_pos_emb(t, freqs, transpose_output_memory=False):
+    """Apply RoPE to ``t`` of layout ``(seq, batch, head, dim)`` with
+    ``freqs`` of shape ``(seq, 1, 1, rot_dim)`` (apex
+    ``fused_apply_rotary_pos_emb``)."""
+    del transpose_output_memory  # memory-format hint is meaningless on TPU
+    f = freqs.astype(_f32)
+    return _rope_sbhd(t, jnp.cos(f), jnp.sin(f))
+
+
+def fused_apply_rotary_pos_emb_cached(t, cos_cached, sin_cached):
+    """Variant taking precomputed cos/sin (apex ``..._cached``)."""
+    return _rope_sbhd(t, cos_cached.astype(_f32), sin_cached.astype(_f32))
+
+
+def fused_apply_rotary_pos_emb_thd(t, cu_seqlens, freqs):
+    """Packed varlen layout ``(total_tokens, head, dim)`` where sequence i
+    spans ``cu_seqlens[i]:cu_seqlens[i+1]`` — positions restart at each
+    boundary (apex ``fused_apply_rotary_pos_emb_thd``)."""
+    total = t.shape[0]
+    positions = jnp.arange(total, dtype=jnp.int32)
+    # position within sequence = index - start of its sequence
+    seq_id = jnp.searchsorted(cu_seqlens, positions, side="right") - 1
+    local_pos = positions - cu_seqlens[seq_id]
+    f = freqs.astype(_f32)[local_pos]          # (total, 1, rot_dim)
+    f = f.reshape(total, *([1] * (t.ndim - 2)), f.shape[-1])
+    return _rope_sbhd(t, jnp.cos(f), jnp.sin(f))
+
+
+def rope_freqs(seq_len, rot_dim, base=10000.0, dtype=_f32):
+    """Standard RoPE frequency table ``(seq, 1, 1, rot_dim)``."""
+    inv = 1.0 / (base ** (jnp.arange(0, rot_dim, 2, dtype=_f32) / rot_dim))
+    t = jnp.arange(seq_len, dtype=_f32)
+    f = jnp.outer(t, inv)
+    f = jnp.concatenate([f, f], axis=-1)
+    return f.reshape(seq_len, 1, 1, rot_dim).astype(dtype)

@@ -1,0 +1,108 @@
+"""Fused scale+mask+softmax — TPU rebuild of the Megatron kernels
+``csrc/megatron/scaled_masked_softmax_cuda.cu``,
+``scaled_upper_triang_masked_softmax_cuda.cu`` and the generic fallback.
+
+On TPU the scale→mask→softmax chain is a single VPU-friendly fusion that XLA
+performs reliably; the custom_vjp here reproduces the CUDA kernels' *memory*
+behavior — the backward uses only the saved softmax output
+(``dx = (dy - Σ dy·y) · y · scale``), never the logits — which is the actual
+win of the fused kernel.  Unlike the CUDA kernels there is no seq≤4K
+template limit.
+
+Masks follow apex conventions: boolean mask with True = masked-out
+(filled with -10000 before softmax), or the causal (upper-triangular)
+variant with no materialized mask.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_f32 = jnp.float32
+MASK_FILL = -10000.0
+
+
+def _softmax_last(x):
+    x = x - jax.lax.stop_gradient(jnp.max(x, axis=-1, keepdims=True))
+    ex = jnp.exp(x)
+    return ex / jnp.sum(ex, axis=-1, keepdims=True)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _scaled_masked_softmax(x, mask, scale):
+    y, _ = _sms_fwd(x, mask, scale)
+    return y
+
+
+def _sms_fwd(x, mask, scale):
+    xs = x.astype(_f32) * scale
+    if mask is not None:
+        xs = jnp.where(mask, MASK_FILL, xs)
+    y = _softmax_last(xs).astype(x.dtype)
+    return y, (y,)
+
+
+def _sms_bwd(scale, res, dy):
+    (y,) = res
+    yf = y.astype(_f32)
+    dyf = dy.astype(_f32)
+    dx = (dyf - jnp.sum(dyf * yf, axis=-1, keepdims=True)) * yf * scale
+    return dx.astype(dy.dtype), None
+
+
+_scaled_masked_softmax.defvjp(_sms_fwd, _sms_bwd)
+
+
+def scaled_masked_softmax(x, mask, scale=1.0):
+    """``softmax(scale*x masked_fill(mask, -10000))`` over the last axis.
+
+    x: ``(b, np, sq, sk)`` attention scores; mask: broadcastable boolean,
+    True = masked (apex ``ScaledMaskedSoftmax``).
+    """
+    return _scaled_masked_softmax(x, mask, float(scale))
+
+
+def scaled_softmax(x, scale=1.0):
+    """No-mask variant (apex ``ScaledSoftmax``)."""
+    return _scaled_masked_softmax(x, None, float(scale))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def scaled_upper_triang_masked_softmax(x, scale=1.0):
+    """Causal softmax for ``(b, sq, sk)`` scores (apex
+    ``ScaledUpperTriangMaskedSoftmax``): position q attends to k ≤ q."""
+    y, _ = _sutms_fwd(x, scale)
+    return y
+
+
+def _causal_mask(sq, sk):
+    q = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+    k = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+    return k > q + (sk - sq)
+
+
+def _sutms_fwd(x, scale):
+    sq, sk = x.shape[-2], x.shape[-1]
+    xs = x.astype(_f32) * scale
+    xs = jnp.where(_causal_mask(sq, sk), MASK_FILL, xs)
+    y = _softmax_last(xs).astype(x.dtype)
+    return y, (y,)
+
+
+def _sutms_bwd(scale, res, dy):
+    (y,) = res
+    yf = y.astype(_f32)
+    dyf = dy.astype(_f32)
+    dx = (dyf - jnp.sum(dyf * yf, axis=-1, keepdims=True)) * yf * scale
+    return (dx.astype(dy.dtype),)
+
+
+scaled_upper_triang_masked_softmax.defvjp(_sutms_fwd, _sutms_bwd)
+
+
+def generic_scaled_masked_softmax(x, mask, scale=1.0):
+    """Arbitrary-shape fallback (apex ``generic_scaled_masked_softmax``)."""
+    return _scaled_masked_softmax(x, mask, float(scale))

@@ -1,0 +1,79 @@
+"""Fused softmax + cross-entropy — TPU rebuild of
+``apex/contrib/csrc/xentropy/xentropy_kernel.cu`` (+
+``apex/contrib/xentropy/softmax_xentropy.py``).
+
+The fused kernel's value is memory, not math: forward computes the loss from
+one pass (max, logsumexp, label pick) without materializing softmax;
+backward reconstructs ``softmax - onehot`` from the saved logsumexp.  The
+custom_vjp below has the same residual footprint (logits are the function's
+own input; only ``lse`` and ``max`` are extra) and XLA fuses each pass.
+Label smoothing matches apex: loss = (1-s)·nll + s·mean-over-classes.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_f32 = jnp.float32
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def softmax_cross_entropy_loss(logits, labels, smoothing=0.0,
+                               ignore_index=-100):
+    """Per-example loss ``(N,)`` for logits ``(N, C)`` and int labels
+    ``(N,)``; apex ``SoftmaxCrossEntropyLoss.apply`` semantics (half grads
+    OK, ``ignore_index`` rows contribute zero loss and zero grad)."""
+    loss, _ = _xent_fwd(logits, labels, smoothing, ignore_index)
+    return loss
+
+
+def _xent_fwd(logits, labels, smoothing, ignore_index):
+    x = logits.astype(_f32)
+    m = jnp.max(x, axis=-1, keepdims=True)
+    lse = jnp.log(jnp.sum(jnp.exp(x - m), axis=-1)) + m[..., 0]
+    n = x.shape[0]
+    valid = labels != ignore_index
+    safe_labels = jnp.where(valid, labels, 0)
+    picked = x[jnp.arange(n), safe_labels]
+    nll = lse - picked
+    if smoothing > 0.0:
+        smooth_loss = lse - jnp.mean(x, axis=-1)
+        loss = (1.0 - smoothing) * nll + smoothing * smooth_loss
+    else:
+        loss = nll
+    loss = jnp.where(valid, loss, 0.0).astype(logits.dtype)
+    return loss, (logits, safe_labels, valid, lse)
+
+
+def _xent_bwd(smoothing, ignore_index, res, dloss):
+    logits, labels, valid, lse = res
+    x = logits.astype(_f32)
+    n, c = x.shape
+    soft = jnp.exp(x - lse[:, None])
+    grad = soft
+    onehot = jax.nn.one_hot(labels, c, dtype=_f32)
+    if smoothing > 0.0:
+        grad = grad - (1.0 - smoothing) * onehot - smoothing / c
+    else:
+        grad = grad - onehot
+    grad = grad * jnp.where(valid, dloss.astype(_f32), 0.0)[:, None]
+    return grad.astype(logits.dtype), None
+
+
+softmax_cross_entropy_loss.defvjp(_xent_fwd, _xent_bwd)
+
+
+class SoftmaxCrossEntropyLoss:
+    """Class shim matching ``apex.contrib.xentropy.SoftmaxCrossEntropyLoss``
+    (static ``apply``)."""
+
+    @staticmethod
+    def apply(logits, labels, smoothing=0.0, padding_idx=-100, half_to_float=False):
+        loss = softmax_cross_entropy_loss(logits, labels, float(smoothing),
+                                          int(padding_idx))
+        if half_to_float:
+            loss = loss.astype(_f32)
+        return loss
