@@ -66,7 +66,6 @@ PROMOTE = {
     "nextafter",
     "concatenate",
     "select_n",
-    "atan2",
 }
 
 

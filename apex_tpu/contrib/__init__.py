@@ -1,0 +1,41 @@
+"""apex.contrib equivalent — opt-in fused extensions.
+
+Each subpackage mirrors an apex contrib feature; all are importable without
+build flags (the Pallas/XLA path needs no compilation step)."""
+
+import importlib as _importlib
+
+_SUBMODULES = (
+    "clip_grad",
+    "xentropy",
+    "focal_loss",
+    "group_norm",
+    "groupbn",
+    "index_mul_2d",
+    "multihead_attn",
+    "fmha",
+    "layer_norm",
+    "optimizers",
+    "sparsity",
+    "transducer",
+    "bottleneck",
+    "peer_memory",
+)
+
+
+def __getattr__(name):
+    if name in _SUBMODULES:
+        try:
+            return _importlib.import_module(f"apex_tpu.contrib.{name}")
+        except ModuleNotFoundError as e:
+            if e.name == f"apex_tpu.contrib.{name}":
+                raise AttributeError(
+                    f"apex_tpu.contrib submodule {name!r} is not available"
+                ) from None
+            raise
+    raise AttributeError(f"module 'apex_tpu.contrib' has no attribute "
+                         f"{name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_SUBMODULES))

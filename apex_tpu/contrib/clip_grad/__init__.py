@@ -1,0 +1,43 @@
+"""Fast gradient clipping — TPU rebuild of
+``apex/contrib/clip_grad/clip_grad.py``.
+
+Apex computes the global norm with one ``multi_tensor_l2norm`` launch and
+rescales with one ``multi_tensor_scale``.  Same two fused passes here over
+the packed buckets; functional (returns the clipped pytree and the norm).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.multi_tensor_apply import (multi_tensor_l2norm,
+                                         multi_tensor_scale)
+
+__all__ = ["clip_grad_norm_"]
+
+
+def clip_grad_norm_(grads, max_norm: float, norm_type: float = 2.0,
+                    error_if_nonfinite: bool = False):
+    """Clip the gradient pytree to global ``max_norm``.
+
+    Returns ``(clipped_grads, total_norm)``.  ``norm_type`` 2.0 uses the
+    fused kernel; other norms fall back to a jnp reduction (apex does the
+    same: only L2 is multi-tensor)."""
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    if norm_type == 2.0:
+        total_norm, _, finf = multi_tensor_l2norm(leaves)
+    else:
+        acc = jnp.zeros((), jnp.float32)
+        for g in leaves:
+            acc = acc + jnp.sum(
+                jnp.abs(g.astype(jnp.float32)) ** norm_type)
+        total_norm = acc ** (1.0 / norm_type)
+        finf = jnp.logical_not(jnp.isfinite(total_norm)).astype(jnp.float32)
+    if error_if_nonfinite:
+        # functional setting: surface as NaN-poisoned outputs instead of a
+        # host-side raise (no sync inside jit)
+        total_norm = jnp.where(finf > 0, jnp.nan, total_norm)
+    clip_coef = jnp.minimum(max_norm / (total_norm + 1e-6), 1.0)
+    clipped, _ = multi_tensor_scale(leaves, clip_coef)
+    return jax.tree_util.tree_unflatten(treedef, clipped), total_norm

@@ -1,0 +1,7 @@
+"""apex.contrib.xentropy parity shim (implementation in
+``apex_tpu.ops.xentropy``)."""
+
+from apex_tpu.ops.xentropy import (SoftmaxCrossEntropyLoss,
+                                   softmax_cross_entropy_loss)
+
+__all__ = ["SoftmaxCrossEntropyLoss", "softmax_cross_entropy_loss"]

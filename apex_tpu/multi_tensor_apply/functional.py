@@ -143,7 +143,12 @@ class MultiTensorApply:
 
     def __init__(self, chunk_size: int = B.DEFAULT_BLOCK_ROWS * B.LANE):
         self.chunk_size = int(chunk_size)
-        self.block_rows = max(8, self.chunk_size // B.LANE)
+        # apex chunk sizes reach 2048*32768; the Pallas block must stay a
+        # multiple of 8 sublanes and small enough that a ~7-operand kernel
+        # (adam) fits VMEM, so round up and clamp to [8, 2*default].
+        rows = -(-self.chunk_size // B.LANE)
+        rows = (rows + 7) // 8 * 8
+        self.block_rows = max(8, min(2 * B.DEFAULT_BLOCK_ROWS, rows))
 
     def __call__(self, op, noop_flag, tensor_lists, *args, **kwargs):
         params = inspect.signature(op).parameters

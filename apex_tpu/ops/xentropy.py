@@ -20,17 +20,20 @@ import jax.numpy as jnp
 _f32 = jnp.float32
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
 def softmax_cross_entropy_loss(logits, labels, smoothing=0.0,
-                               ignore_index=-100):
+                               ignore_index=-100, half_to_float=False):
     """Per-example loss ``(N,)`` for logits ``(N, C)`` and int labels
     ``(N,)``; apex ``SoftmaxCrossEntropyLoss.apply`` semantics (half grads
-    OK, ``ignore_index`` rows contribute zero loss and zero grad)."""
-    loss, _ = _xent_fwd(logits, labels, smoothing, ignore_index)
+    OK, ``ignore_index`` rows contribute zero loss and zero grad).
+    ``half_to_float`` keeps the f32-computed loss at full precision instead
+    of rounding to the logits dtype (apex's fused kernel returns f32)."""
+    loss, _ = _xent_fwd(logits, labels, smoothing, ignore_index,
+                        half_to_float)
     return loss
 
 
-def _xent_fwd(logits, labels, smoothing, ignore_index):
+def _xent_fwd(logits, labels, smoothing, ignore_index, half_to_float=False):
     x = logits.astype(_f32)
     m = jnp.max(x, axis=-1, keepdims=True)
     lse = jnp.log(jnp.sum(jnp.exp(x - m), axis=-1)) + m[..., 0]
@@ -44,11 +47,13 @@ def _xent_fwd(logits, labels, smoothing, ignore_index):
         loss = (1.0 - smoothing) * nll + smoothing * smooth_loss
     else:
         loss = nll
-    loss = jnp.where(valid, loss, 0.0).astype(logits.dtype)
+    loss = jnp.where(valid, loss, 0.0)
+    if not half_to_float:
+        loss = loss.astype(logits.dtype)
     return loss, (logits, safe_labels, valid, lse)
 
 
-def _xent_bwd(smoothing, ignore_index, res, dloss):
+def _xent_bwd(smoothing, ignore_index, half_to_float, res, dloss):
     logits, labels, valid, lse = res
     x = logits.astype(_f32)
     n, c = x.shape
@@ -71,9 +76,8 @@ class SoftmaxCrossEntropyLoss:
     (static ``apply``)."""
 
     @staticmethod
-    def apply(logits, labels, smoothing=0.0, padding_idx=-100, half_to_float=False):
-        loss = softmax_cross_entropy_loss(logits, labels, float(smoothing),
-                                          int(padding_idx))
-        if half_to_float:
-            loss = loss.astype(_f32)
-        return loss
+    def apply(logits, labels, smoothing=0.0, padding_idx=-100,
+              half_to_float=False):
+        return softmax_cross_entropy_loss(logits, labels, float(smoothing),
+                                          int(padding_idx),
+                                          bool(half_to_float))

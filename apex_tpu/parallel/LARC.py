@@ -1,0 +1,100 @@
+"""LARC — TPU rebuild of ``apex/parallel/LARC.py``.
+
+Layer-wise Adaptive Rate Clipping/Scaling: per-tensor adaptive lr
+``η·‖p‖/(‖g‖ + wd·‖p‖)``, either clipped against the base lr (``clip=True``)
+or used as a pure scale.  Apex implements it as an optimizer wrapper that
+rewrites each param group's gradients before the inner ``step``; the same
+shape here — :class:`LARC` wraps a fused optimizer and rescales the gradient
+pytree per tensor — plus an optax ``larc`` transform for native JAX loops.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_f32 = jnp.float32
+
+
+def _larc_scale(p, g, lr, trust_coefficient, clip, eps, weight_decay):
+    pn = jnp.linalg.norm(p.astype(_f32))
+    gn = jnp.linalg.norm(g.astype(_f32))
+    adaptive = trust_coefficient * pn / (gn + weight_decay * pn + eps)
+    # apex guards: params with zero norm or zero grad keep the base lr
+    adaptive = jnp.where((pn > 0) & (gn > 0), adaptive, lr)
+    if clip:
+        scale = jnp.minimum(adaptive / lr, 1.0)
+    else:
+        scale = adaptive / lr
+    return scale
+
+
+class LARC:
+    """Wrapper: ``LARC(FusedSGD(lr=...), trust_coefficient=0.02)``.
+
+    ``step(grads, params, state)`` rescales each gradient tensor by the LARC
+    factor then delegates to the wrapped optimizer (which applies weight
+    decay itself, like apex's flow where LARC zeroes group wd and folds it
+    into the gradient)."""
+
+    def __init__(self, optimizer, trust_coefficient: float = 0.02,
+                 clip: bool = True, eps: float = 1e-8):
+        self.optimizer = optimizer
+        self.trust_coefficient = float(trust_coefficient)
+        self.clip = bool(clip)
+        self.eps = float(eps)
+
+    def init(self, params):
+        return self.optimizer.init(params)
+
+    def step(self, grads, params, state, *, lr=None, **kw):
+        base_lr = lr if lr is not None else self.optimizer.defaults["lr"]
+        wd = self.optimizer.defaults.get("weight_decay", 0.0)
+
+        def rescale(p, g):
+            s = _larc_scale(p, g, base_lr, self.trust_coefficient,
+                            self.clip, self.eps, wd)
+            # apex folds wd into the grad, then scales: g' = s*(g + wd*p)
+            gf = g.astype(_f32) + wd * p.astype(_f32)
+            return (s * gf).astype(g.dtype)
+
+        if wd != 0.0:
+            grads = jax.tree_util.tree_map(rescale, params, grads)
+            # inner optimizer must not double-apply decay
+            kw = dict(kw)
+            saved_wd = self.optimizer.defaults["weight_decay"]
+            self.optimizer.defaults["weight_decay"] = 0.0
+            try:
+                out = self.optimizer.step(grads, params, state, lr=lr, **kw)
+            finally:
+                self.optimizer.defaults["weight_decay"] = saved_wd
+            return out
+        grads = jax.tree_util.tree_map(
+            lambda p, g: (_larc_scale(p, g, base_lr,
+                                      self.trust_coefficient, self.clip,
+                                      self.eps, 0.0)
+                          * g.astype(_f32)).astype(g.dtype),
+            params, grads)
+        return self.optimizer.step(grads, params, state, lr=lr, **kw)
+
+
+def larc(trust_coefficient: float = 0.02, clip: bool = True,
+         eps: float = 1e-8, weight_decay: float = 0.0, learning_rate=1.0):
+    """optax-style gradient transformation applying LARC scaling."""
+    import optax
+
+    def init_fn(params):
+        return optax.EmptyState()
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError("larc requires params")
+
+        def rescale(p, g):
+            s = _larc_scale(p, g, learning_rate, trust_coefficient, clip,
+                            eps, weight_decay)
+            return (s * g.astype(_f32)).astype(g.dtype)
+
+        return jax.tree_util.tree_map(rescale, params, updates), state
+
+    return optax.GradientTransformation(init_fn, update_fn)

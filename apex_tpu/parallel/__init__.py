@@ -1,0 +1,28 @@
+"""apex.parallel equivalent: data parallelism over the ICI mesh."""
+
+from apex_tpu.parallel.distributed import (
+    DistributedDataParallel,
+    Reducer,
+    allreduce_gradients,
+    DEFAULT_DATA_AXIS,
+)
+from apex_tpu.parallel.sync_batchnorm import (
+    SyncBatchNorm,
+    BatchNormState,
+    sync_batch_norm,
+    convert_syncbn_model,
+)
+from apex_tpu.parallel.LARC import LARC, larc
+
+__all__ = [
+    "DistributedDataParallel",
+    "Reducer",
+    "allreduce_gradients",
+    "DEFAULT_DATA_AXIS",
+    "SyncBatchNorm",
+    "BatchNormState",
+    "sync_batch_norm",
+    "convert_syncbn_model",
+    "LARC",
+    "larc",
+]

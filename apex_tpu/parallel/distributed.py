@@ -1,0 +1,182 @@
+"""Data parallelism — TPU rebuild of ``apex/parallel/distributed.py``.
+
+Apex's ``DistributedDataParallel`` registers per-param backward hooks,
+buckets gradients in reverse creation order (``message_size`` bytes per
+bucket), flattens them (``apex_C.flatten``) and overlaps NCCL allreduce with
+the remaining backward.  On TPU every one of those jobs belongs to the
+compiler: gradients produced inside a jitted step with a sharded batch are
+reduced by XLA-inserted collectives over ICI, and the XLA latency-hiding
+scheduler overlaps them with compute.  What remains for the API is:
+
+* expressing the data-parallel layout (mesh axis, batch sharding,
+  replicated params) — :class:`DistributedDataParallel`;
+* the explicit-collective path for ``shard_map`` training loops —
+  :func:`allreduce_gradients` (= apex's bucketed allreduce, one ``psum``);
+* the manual-trigger variant — :class:`Reducer`;
+* ``delay_allreduce`` semantics → gradient-accumulation boundary control.
+
+Knobs that only make sense for NCCL stream management (``message_size``,
+``num_allreduce_streams``, ``allreduce_communicators``) are accepted and
+ignored so apex recipes run unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DEFAULT_DATA_AXIS = "data"
+
+
+def _has_axis(axis_name) -> bool:
+    try:
+        jax.lax.axis_index(axis_name)
+        return True
+    except NameError:
+        return False
+
+
+def allreduce_gradients(grads, axis_name: str = DEFAULT_DATA_AXIS,
+                        average: bool = True):
+    """Reduce a gradient pytree across the data-parallel axis.
+
+    Inside ``shard_map``/``pmap`` this is one fused ``psum`` over the whole
+    pytree (XLA concatenates it into large transfers — the moral equivalent
+    of apex's flatten+bucket).  ``average=True`` mirrors apex's
+    ``gradient_average`` (divide by world size).
+    """
+    reduced = jax.lax.psum(grads, axis_name)
+    if average:
+        n = jax.lax.axis_size(axis_name)
+        reduced = jax.tree_util.tree_map(lambda g: g / n, reduced)
+    return reduced
+
+
+class DistributedDataParallel:
+    """API-compat DP wrapper (apex ``apex.parallel.DistributedDataParallel``).
+
+    Functional usage over a named mesh::
+
+        mesh = jax.make_mesh((n_devices,), ("data",))
+        ddp = DistributedDataParallel(apply_fn, mesh=mesh)
+        params = ddp.broadcast_params(params)       # replicate (init bcast)
+        batch  = ddp.scatter(batch)                 # shard along batch dim
+        # inside jit: grads come out correct — GSPMD inserts the reduction
+
+    For explicit-collective loops (``shard_map``), use
+    ``ddp.reduce(grads)`` where apex called the bucketed allreduce.
+
+    ``delay_allreduce=True`` (apex: allreduce only at the end of backward)
+    maps to gradient accumulation: accumulate with ``ddp.accumulate`` and
+    reduce once via ``ddp.reduce`` at the boundary.
+    """
+
+    def __init__(self, module: Optional[Callable] = None, *,
+                 mesh: Optional[Mesh] = None,
+                 axis_name: str = DEFAULT_DATA_AXIS,
+                 message_size: int = 10_000_000,
+                 delay_allreduce: bool = False,
+                 shared_param: bool = None,
+                 allreduce_trigger_params=None,
+                 retain_allreduce_buffers: bool = False,
+                 allreduce_always_fp32: bool = False,
+                 num_allreduce_streams: int = 1,
+                 allreduce_communicators=None,
+                 gradient_average: bool = True,
+                 gradient_predivide_factor: float = 1.0,
+                 prof: bool = False):
+        del (message_size, shared_param, allreduce_trigger_params,
+             retain_allreduce_buffers, num_allreduce_streams,
+             allreduce_communicators, prof)  # NCCL-only knobs
+        self.module = module
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.delay_allreduce = bool(delay_allreduce)
+        self.allreduce_always_fp32 = bool(allreduce_always_fp32)
+        self.gradient_average = bool(gradient_average)
+        self.gradient_predivide_factor = float(gradient_predivide_factor)
+
+    # -- GSPMD path --------------------------------------------------------
+
+    def broadcast_params(self, params):
+        """Replicate params across the mesh (apex: init-time
+        ``flat_dist_call`` broadcast from rank 0)."""
+        if self.mesh is None:
+            return params
+        repl = NamedSharding(self.mesh, P())
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, repl), params)
+
+    def scatter(self, batch):
+        """Shard a host batch along its leading dim over the data axis."""
+        if self.mesh is None:
+            return batch
+        sh = NamedSharding(self.mesh, P(self.axis_name))
+        return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), batch)
+
+    def __call__(self, params, *args, **kwargs):
+        if self.module is None:
+            raise ValueError("DistributedDataParallel wrapped no module")
+        return self.module(params, *args, **kwargs)
+
+    # -- explicit-collective path (shard_map) ------------------------------
+
+    def mark_local(self, params):
+        """Mark replicated params device-varying inside ``shard_map``.
+
+        JAX's varying-axes tracking makes ``jax.grad`` w.r.t. *replicated*
+        inputs insert the cross-device ``psum`` automatically (the transpose
+        of the implicit broadcast).  To reproduce apex's DDP staging — local
+        gradients first, one explicit bucketed allreduce after — cast params
+        to varying before ``jax.grad``, then call :meth:`reduce` yourself::
+
+            def step(params, x, y):
+                params = ddp.mark_local(params)
+                grads = jax.grad(loss_fn)(params, x, y)   # local grads
+                grads = ddp.reduce(grads)                 # ONE allreduce
+                ...
+
+        Skip both calls and grads come out already summed (not averaged) —
+        the compiler-managed path.
+        """
+        return jax.tree_util.tree_map(
+            lambda x: jax.lax.pcast(x, self.axis_name, to="varying"), params)
+
+    def reduce(self, grads):
+        """The bucketed allreduce, as one collective (use inside
+        ``shard_map``)."""
+        if self.allreduce_always_fp32:
+            grads = jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32), grads)
+        factor = self.gradient_predivide_factor
+        if self.gradient_average and factor != 1.0:
+            # apex staging: divide by `factor` before the reduce and by
+            # `world/factor` after (spreads the scaling for fp16 safety)
+            grads = jax.tree_util.tree_map(lambda g: g / factor, grads)
+            out = jax.lax.psum(grads, self.axis_name)
+            n = jax.lax.axis_size(self.axis_name)
+            return jax.tree_util.tree_map(lambda g: g * (factor / n), out)
+        return allreduce_gradients(grads, self.axis_name,
+                                   average=self.gradient_average)
+
+    @staticmethod
+    def accumulate(acc, grads):
+        """Microbatch gradient accumulation (``delay_allreduce`` interior)."""
+        if acc is None:
+            return grads
+        return jax.tree_util.tree_map(jnp.add, acc, grads)
+
+
+class Reducer:
+    """Manual-trigger allreduce helper (apex ``apex.parallel.Reducer``):
+    call ``reduce`` on whatever pytree you like, when you like."""
+
+    def __init__(self, module_or_grads_list=None,
+                 axis_name: str = DEFAULT_DATA_AXIS):
+        self.axis_name = axis_name
+
+    def reduce(self, tree, average: bool = True):
+        return allreduce_gradients(tree, self.axis_name, average=average)
