@@ -1,0 +1,26 @@
+"""apex.transformer equivalent: Megatron-style model parallelism on a TPU
+mesh (reference: ``apex/transformer/__init__.py``)."""
+
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer import tensor_parallel
+from apex_tpu.transformer import pipeline_parallel
+from apex_tpu.transformer.microbatches import (
+    build_num_microbatches_calculator,
+    ConstantNumMicroBatches,
+    RampupBatchsizeNumMicroBatches,
+)
+from apex_tpu.transformer.enums import (AttnMaskType, AttnType, LayerType,
+                                        ModelType)
+
+__all__ = [
+    "parallel_state",
+    "tensor_parallel",
+    "pipeline_parallel",
+    "build_num_microbatches_calculator",
+    "ConstantNumMicroBatches",
+    "RampupBatchsizeNumMicroBatches",
+    "AttnMaskType",
+    "AttnType",
+    "LayerType",
+    "ModelType",
+]

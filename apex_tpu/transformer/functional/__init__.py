@@ -1,0 +1,15 @@
+from apex_tpu.transformer.functional.fused_softmax import (
+    FusedScaleMaskSoftmax,
+)
+from apex_tpu.ops.rope import (
+    fused_apply_rotary_pos_emb,
+    fused_apply_rotary_pos_emb_cached,
+    fused_apply_rotary_pos_emb_thd,
+)
+
+__all__ = [
+    "FusedScaleMaskSoftmax",
+    "fused_apply_rotary_pos_emb",
+    "fused_apply_rotary_pos_emb_cached",
+    "fused_apply_rotary_pos_emb_thd",
+]

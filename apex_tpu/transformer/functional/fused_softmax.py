@@ -1,0 +1,71 @@
+"""FusedScaleMaskSoftmax — TPU rebuild of
+``apex/transformer/functional/fused_softmax.py``.
+
+Apex dispatches between three CUDA kernels (causal / masked / generic) by
+shape and a ``is_kernel_available`` check with seq≤4K templates; the TPU
+ops have no such limits so dispatch is purely on mask type.  The
+``scaled_masked_softmax_fusion`` flag and fp16/bf16 flags are kept for
+constructor parity (mask_func/softmax_in_fp32 behave as in apex).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.softmax import (scaled_masked_softmax, scaled_softmax,
+                                  scaled_upper_triang_masked_softmax)
+from apex_tpu.transformer.enums import AttnMaskType
+
+
+class FusedScaleMaskSoftmax:
+    def __init__(self, input_in_fp16: bool = False,
+                 input_in_bf16: bool = True,
+                 attn_mask_type: AttnMaskType = AttnMaskType.padding,
+                 scaled_masked_softmax_fusion: bool = True,
+                 mask_func: Optional[Callable] = None,
+                 softmax_in_fp32: bool = True,
+                 scale: Optional[float] = None):
+        if input_in_fp16 and input_in_bf16:
+            raise RuntimeError(
+                "both fp16 and bf16 flags cannot be active at the same "
+                "time.")  # apex parity
+        if scale is not None and not softmax_in_fp32:
+            raise RuntimeError(
+                "softmax should be in fp32 when scaled")  # apex parity
+        self.attn_mask_type = attn_mask_type
+        self.mask_func = mask_func
+        self.softmax_in_fp32 = softmax_in_fp32
+        self.scale = 1.0 if scale is None else float(scale)
+
+    def __call__(self, x, mask=None):
+        if not self.softmax_in_fp32:
+            # apex non-fp32 path: softmax in the input dtype
+            xs = x * jnp.asarray(self.scale, x.dtype)
+            if self.attn_mask_type == AttnMaskType.causal:
+                sq, sk = x.shape[-2], x.shape[-1]
+                from apex_tpu.ops.softmax import _causal_mask, MASK_FILL
+                xs = jnp.where(_causal_mask(sq, sk), MASK_FILL, xs)
+            elif mask is not None:
+                if self.mask_func is not None:
+                    xs = self.mask_func(xs, mask)
+                else:
+                    xs = jnp.where(mask, jnp.asarray(-10000.0, x.dtype), xs)
+            return jax.nn.softmax(xs, axis=-1)
+        if self.attn_mask_type == AttnMaskType.causal:
+            # apex kernel takes (b*np, sq, sk)
+            b, np_, sq, sk = x.shape
+            y = scaled_upper_triang_masked_softmax(
+                x.reshape(b * np_, sq, sk), self.scale)
+            return y.reshape(b, np_, sq, sk)
+        if mask is not None:
+            if self.mask_func is not None:
+                xm = self.mask_func(x.astype(jnp.float32) * self.scale,
+                                    mask)
+                return scaled_masked_softmax(xm, None, 1.0).astype(x.dtype)
+            return scaled_masked_softmax(x, mask, self.scale)
+        return scaled_softmax(x, self.scale)
+
+    forward = __call__

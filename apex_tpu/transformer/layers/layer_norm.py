@@ -1,0 +1,24 @@
+"""TPU rebuild of ``apex/transformer/layers/layer_norm.py``.
+
+Apex picks FastLayerNorm (fixed hidden sizes) or MixedFusedLayerNorm and
+tags weights with ``sequence_parallel_enabled`` so the grad-sync pass knows
+those params are replicated along the sequence-parallel region.  Here both
+names resolve to the Pallas-backed mixed norm; the sequence-parallel tag is
+carried on the module (GSPMD handles the replication, the tag is for
+recipe-level introspection)."""
+
+from __future__ import annotations
+
+from apex_tpu.normalization.fused_layer_norm import MixedFusedLayerNorm
+
+
+class FusedLayerNorm(MixedFusedLayerNorm):
+    def __init__(self, hidden_size, eps=1e-5,
+                 sequence_parallel_enabled: bool = False, **kw):
+        super().__init__(hidden_size, eps=eps, **kw)
+        self.sequence_parallel_enabled = bool(sequence_parallel_enabled)
+
+
+class FastLayerNorm(FusedLayerNorm):
+    """apex routes hidden sizes with a persistent kernel here; the Pallas
+    kernel handles every size, so this is an alias."""

@@ -1,0 +1,185 @@
+"""Model-parallel topology — TPU rebuild of ``apex/transformer/parallel_state.py``.
+
+Apex builds NCCL process groups for TP/PP/DP (plus embedding and
+position-embedding groups) from a world of ranks.  On TPU the topology is a
+named :class:`jax.sharding.Mesh` over the device grid — collectives are
+compiled against mesh axes, so "groups" are just axis names:
+
+* ``data``  — data parallel (apex ``_DATA_PARALLEL_GROUP``)
+* ``pipe``  — pipeline model parallel (apex ``_PIPELINE_MODEL_PARALLEL_GROUP``)
+* ``model`` — tensor model parallel (apex ``_TENSOR_MODEL_PARALLEL_GROUP``)
+
+``initialize_model_parallel`` mirrors the apex signature (sizes +
+virtual-pipeline + split rank) and stores a module-global mesh; rank/world
+accessors return traced values inside ``shard_map``/``pjit`` contexts (via
+``axis_index``) and host-side integers otherwise, so code written against
+the apex accessors works in both worlds.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+PIPELINE_AXIS = "pipe"
+TENSOR_AXIS = "model"
+
+_MESH: Optional[Mesh] = None
+_VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK: Optional[int] = None
+_VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE: Optional[int] = None
+_PIPELINE_MODEL_PARALLEL_SPLIT_RANK: Optional[int] = None
+
+
+def initialize_model_parallel(
+        tensor_model_parallel_size_: int = 1,
+        pipeline_model_parallel_size_: int = 1,
+        virtual_pipeline_model_parallel_size_: Optional[int] = None,
+        pipeline_model_parallel_split_rank_: Optional[int] = None,
+        *, devices=None, default_backend=None) -> Mesh:
+    """Build and install the global ``(data, pipe, model)`` mesh.
+
+    World size is ``len(devices)`` (default: all JAX devices); the data
+    parallel size is inferred as ``world // (tp * pp)`` exactly like apex.
+    Returns the mesh (also retrievable via :func:`get_mesh`).
+    """
+    global _MESH, _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
+    global _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK
+    global _PIPELINE_MODEL_PARALLEL_SPLIT_RANK
+    del default_backend  # apex arg (nccl/ucc); meaningless here
+    devices = list(devices if devices is not None else jax.devices())
+    world = len(devices)
+    tp = int(tensor_model_parallel_size_)
+    pp = int(pipeline_model_parallel_size_)
+    if world % (tp * pp) != 0:
+        raise RuntimeError(
+            f"world size ({world}) is not divisible by tensor parallel "
+            f"size ({tp}) x pipeline parallel size ({pp})")
+    dp = world // (tp * pp)
+    dev_array = np.asarray(devices).reshape(dp, pp, tp)
+    _MESH = Mesh(dev_array, (DATA_AXIS, PIPELINE_AXIS, TENSOR_AXIS))
+    if virtual_pipeline_model_parallel_size_ is not None:
+        _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK = 0
+        _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE = \
+            int(virtual_pipeline_model_parallel_size_)
+    else:
+        _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK = None
+        _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE = None
+    _PIPELINE_MODEL_PARALLEL_SPLIT_RANK = pipeline_model_parallel_split_rank_
+    return _MESH
+
+
+def model_parallel_is_initialized() -> bool:
+    return _MESH is not None
+
+
+def get_mesh() -> Mesh:
+    if _MESH is None:
+        raise RuntimeError("model parallel mesh is not initialized "
+                           "(call initialize_model_parallel first)")
+    return _MESH
+
+
+def destroy_model_parallel():
+    global _MESH, _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK
+    global _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
+    global _PIPELINE_MODEL_PARALLEL_SPLIT_RANK
+    _MESH = None
+    _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK = None
+    _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE = None
+    _PIPELINE_MODEL_PARALLEL_SPLIT_RANK = None
+
+
+# -- world sizes (host-side static) -----------------------------------------
+
+def get_tensor_model_parallel_world_size() -> int:
+    return get_mesh().shape[TENSOR_AXIS]
+
+
+def get_pipeline_model_parallel_world_size() -> int:
+    return get_mesh().shape[PIPELINE_AXIS]
+
+
+def get_data_parallel_world_size() -> int:
+    return get_mesh().shape[DATA_AXIS]
+
+
+def get_model_parallel_world_size() -> int:
+    """tp*pp (apex asserts pp==1 here; we return the product)."""
+    return (get_tensor_model_parallel_world_size()
+            * get_pipeline_model_parallel_world_size())
+
+
+# -- ranks (traced inside shard_map, 0 on host) ------------------------------
+
+def _axis_rank(axis: str):
+    try:
+        return jax.lax.axis_index(axis)
+    except NameError:
+        return 0
+
+
+def get_tensor_model_parallel_rank():
+    return _axis_rank(TENSOR_AXIS)
+
+
+def get_pipeline_model_parallel_rank():
+    return _axis_rank(PIPELINE_AXIS)
+
+
+def get_data_parallel_rank():
+    return _axis_rank(DATA_AXIS)
+
+
+def is_pipeline_first_stage(ignore_virtual: bool = False):
+    if not ignore_virtual:
+        vp = _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
+        if vp is not None and \
+                get_virtual_pipeline_model_parallel_rank() != 0:
+            return False
+    return get_pipeline_model_parallel_rank() == 0
+
+
+def is_pipeline_last_stage(ignore_virtual: bool = False):
+    if not ignore_virtual:
+        vp = _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
+        if vp is not None and (get_virtual_pipeline_model_parallel_rank()
+                               != vp - 1):
+            return False
+    return (get_pipeline_model_parallel_rank()
+            == get_pipeline_model_parallel_world_size() - 1)
+
+
+# -- virtual pipeline ranks (host-side ints, like apex) ----------------------
+
+def get_virtual_pipeline_model_parallel_rank():
+    return _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK
+
+
+def set_virtual_pipeline_model_parallel_rank(rank: int):
+    global _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK
+    _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK = rank
+
+
+def get_virtual_pipeline_model_parallel_world_size():
+    return _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
+
+
+def get_pipeline_model_parallel_split_rank():
+    return _PIPELINE_MODEL_PARALLEL_SPLIT_RANK
+
+
+def set_pipeline_model_parallel_split_rank(rank: int):
+    global _PIPELINE_MODEL_PARALLEL_SPLIT_RANK
+    _PIPELINE_MODEL_PARALLEL_SPLIT_RANK = rank
+
+
+# -- sharding helpers --------------------------------------------------------
+
+def get_tensor_model_parallel_src_rank() -> int:
+    """apex: global rank of the first rank in one's TP group — under a
+    single-controller mesh this is only meaningful for logging; return 0."""
+    return 0

@@ -1,0 +1,21 @@
+from apex_tpu.transformer.pipeline_parallel.schedules import (
+    get_forward_backward_func,
+    forward_backward_no_pipelining,
+    forward_backward_pipelining_without_interleaving,
+    forward_backward_pipelining_with_interleaving,
+)
+from apex_tpu.transformer.pipeline_parallel.spmd import (
+    spmd_pipeline,
+    pipeline_value_and_grad,
+)
+from apex_tpu.transformer.pipeline_parallel import p2p_communication
+
+__all__ = [
+    "get_forward_backward_func",
+    "forward_backward_no_pipelining",
+    "forward_backward_pipelining_without_interleaving",
+    "forward_backward_pipelining_with_interleaving",
+    "spmd_pipeline",
+    "pipeline_value_and_grad",
+    "p2p_communication",
+]

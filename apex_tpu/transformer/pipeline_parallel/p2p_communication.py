@@ -1,0 +1,86 @@
+"""Stage-to-stage transfers — TPU rebuild of
+``apex/transformer/pipeline_parallel/p2p_communication.py``.
+
+Apex moves activations between pipeline ranks with NCCL
+``batch_isend_irecv`` (plus a shape handshake for variable shapes).  On TPU
+a stage hop is ``lax.ppermute`` over the ``pipe`` mesh axis — compiled to a
+collective-permute riding ICI neighbors — and shapes are static under jit so
+there is no handshake.  These helpers are the explicit building blocks; the
+scan-based engine in ``spmd.py`` is what the schedules actually use.
+
+All functions must run inside ``shard_map`` with the pipe axis in scope.
+The boundary stages receive zeros (a ring permute wraps; the extra wrap
+value is masked here to match apex's "first stage receives nothing").
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.parallel_state import PIPELINE_AXIS
+from apex_tpu.transformer.pipeline_parallel.spmd import _ring_perm
+from apex_tpu.utils.collectives import ensure_varying
+
+
+def _shift(x, axis_name, forward: bool, wrap: bool):
+    n = jax.lax.axis_size(axis_name)
+    perm = _ring_perm(n) if forward else [(d, s) for s, d in _ring_perm(n)]
+    x = ensure_varying(x, axis_name)
+    out = jax.lax.ppermute(x, axis_name, perm)
+    if not wrap:
+        s = jax.lax.axis_index(axis_name)
+        edge = (s == 0) if forward else (s == n - 1)
+        out = jnp.where(edge, jnp.zeros_like(out), out)
+    return out
+
+
+def send_forward_recv_forward(output_tensor, *,
+                              axis_name: str = PIPELINE_AXIS,
+                              wrap: bool = False):
+    """Send to the next stage, receive from the previous (one hop).  In an
+    SPMD program send and recv are the same permute; this single primitive
+    backs apex's ``send_forward``/``recv_forward`` pair."""
+    return _shift(output_tensor, axis_name, forward=True, wrap=wrap)
+
+
+def send_backward_recv_backward(input_tensor_grad, *,
+                                axis_name: str = PIPELINE_AXIS,
+                                wrap: bool = False):
+    """Gradient hop toward earlier stages (apex ``send_backward`` /
+    ``recv_backward``)."""
+    return _shift(input_tensor_grad, axis_name, forward=False, wrap=wrap)
+
+
+# apex's four half-ops map onto the two fused permutes above; aliases keep
+# recipe code readable.
+def send_forward(output_tensor, **kw):
+    return send_forward_recv_forward(output_tensor, **kw)
+
+
+def recv_forward(tensor_like, **kw):
+    return send_forward_recv_forward(tensor_like, **kw)
+
+
+def send_backward(input_tensor_grad, **kw):
+    return send_backward_recv_backward(input_tensor_grad, **kw)
+
+
+def recv_backward(tensor_like, **kw):
+    return send_backward_recv_backward(tensor_like, **kw)
+
+
+def send_forward_recv_backward(output_tensor, grad_like, *,
+                               axis_name: str = PIPELINE_AXIS):
+    """1F1B steady-state fused exchange: activations go forward while
+    gradients come backward (two counter-rotating permutes XLA can
+    overlap)."""
+    return (send_forward_recv_forward(output_tensor, axis_name=axis_name),
+            send_backward_recv_backward(grad_like, axis_name=axis_name))
+
+
+def send_backward_recv_forward(input_tensor_grad, act_like, *,
+                               axis_name: str = PIPELINE_AXIS):
+    return (send_backward_recv_backward(input_tensor_grad,
+                                        axis_name=axis_name),
+            send_forward_recv_forward(act_like, axis_name=axis_name))

@@ -1,0 +1,120 @@
+"""Pipeline schedules — TPU rebuild of
+``apex/transformer/pipeline_parallel/schedules/``.
+
+``get_forward_backward_func`` dispatches exactly like apex: no pipelining
+when the pipe axis is 1, interleaved when a virtual size is set, 1F1B
+otherwise.  All schedule functions share the functional signature::
+
+    fwd_bwd_func(stage_fn, loss_fn, params, microbatches, targets,
+                 forward_only=False, **kw) -> (mean_loss, grads | None)
+
+run inside ``shard_map`` over the ``pipe`` (and optionally other) axes.
+The scan+ppermute engine (``spmd.py``) provides the actual pipelining; the
+1F1B and interleaved entry points differ in chunk placement (``n_virtual``),
+matching apex's schedule split, while the fine-grained backward interleaving
+apex hand-codes is delegated to XLA's scheduler.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.parallel_state import (
+    PIPELINE_AXIS,
+    get_pipeline_model_parallel_world_size,
+    get_virtual_pipeline_model_parallel_world_size,
+)
+from apex_tpu.transformer.pipeline_parallel.spmd import (
+    spmd_pipeline,
+    pipeline_value_and_grad,
+    last_stage_mean_loss,
+)
+
+__all__ = [
+    "get_forward_backward_func",
+    "forward_backward_no_pipelining",
+    "forward_backward_pipelining_without_interleaving",
+    "forward_backward_pipelining_with_interleaving",
+    "spmd_pipeline",
+    "pipeline_value_and_grad",
+]
+
+
+def forward_backward_no_pipelining(stage_fn: Callable, loss_fn: Callable,
+                                   params, microbatches, targets,
+                                   forward_only: bool = False, **kw):
+    """Sequential microbatches, grads accumulated; grad sync naturally
+    happens once at the end (apex: no_sync() except last microbatch)."""
+    del kw
+
+    def loss_of(params):
+        def body(acc, mb):
+            x, t = mb
+            l = loss_fn(stage_fn(params, x), t)
+            return acc + l, l
+        total, per = jax.lax.scan(body, jnp.zeros(()),
+                                  (microbatches, targets))
+        return total / microbatches.shape[0]
+
+    if forward_only:
+        return loss_of(params), None
+    return jax.value_and_grad(loss_of)(params)
+
+
+def forward_backward_pipelining_without_interleaving(
+        stage_fn: Callable, loss_fn: Callable, params, microbatches,
+        targets, forward_only: bool = False,
+        axis_name: str = PIPELINE_AXIS, remat: bool = False, **kw):
+    """1F1B-equivalent SPMD pipeline (apex
+    ``forward_backward_pipelining_without_interleaving``)."""
+    del kw
+    if forward_only:
+        outs = spmd_pipeline(stage_fn, params, microbatches,
+                             axis_name=axis_name, remat=remat)
+        return last_stage_mean_loss(loss_fn, outs, targets, axis_name), None
+    return pipeline_value_and_grad(stage_fn, loss_fn, params, microbatches,
+                                   targets, axis_name=axis_name,
+                                   n_virtual=1, remat=remat)
+
+
+def forward_backward_pipelining_with_interleaving(
+        stage_fn: Callable, loss_fn: Callable, params, microbatches,
+        targets, forward_only: bool = False,
+        axis_name: str = PIPELINE_AXIS, n_virtual: int = 2,
+        remat: bool = False, **kw):
+    """Interleaved/virtual pipeline (apex
+    ``_forward_backward_pipelining_with_interleaving``): params carry a
+    leading ``(n_virtual,)`` chunk axis per leaf."""
+    del kw
+    if forward_only:
+        outs = spmd_pipeline(stage_fn, params, microbatches,
+                             axis_name=axis_name, n_virtual=n_virtual,
+                             remat=remat)
+        return last_stage_mean_loss(loss_fn, outs, targets, axis_name), None
+    return pipeline_value_and_grad(stage_fn, loss_fn, params, microbatches,
+                                   targets, axis_name=axis_name,
+                                   n_virtual=n_virtual, remat=remat)
+
+
+def get_forward_backward_func(
+        virtual_pipeline_model_parallel_size: Optional[int] = None,
+        pipeline_model_parallel_size: Optional[int] = None):
+    """apex ``get_forward_backward_func`` dispatch."""
+    if pipeline_model_parallel_size is None:
+        pipeline_model_parallel_size = \
+            get_pipeline_model_parallel_world_size()
+    if virtual_pipeline_model_parallel_size is None:
+        virtual_pipeline_model_parallel_size = \
+            get_virtual_pipeline_model_parallel_world_size()
+    if pipeline_model_parallel_size > 1:
+        if virtual_pipeline_model_parallel_size is not None and \
+                virtual_pipeline_model_parallel_size > 1:
+            import functools
+            return functools.partial(
+                forward_backward_pipelining_with_interleaving,
+                n_virtual=virtual_pipeline_model_parallel_size)
+        return forward_backward_pipelining_without_interleaving
+    return forward_backward_no_pipelining

@@ -1,0 +1,139 @@
+"""SPMD pipeline engine — the TPU-native core under the apex schedule API
+(reference: ``apex/transformer/pipeline_parallel/schedules/fwd_bwd_schedules``).
+
+Apex drives MPMD pipelining imperatively: each rank loops over microbatches
+doing NCCL P2P ``recv_forward → forward → send_forward`` with a 1F1B
+steady state.  The TPU-native equivalent is a *single SPMD program*: every
+pipeline stage runs the same ``lax.scan`` over ticks, activations rotate one
+hop per tick via ``lax.ppermute`` over the ``pipe`` mesh axis, and autodiff
+of the scan yields the backward pipeline (the transpose of ``ppermute`` is
+the reverse rotation, so backward activations flow stage S-1 → 0 exactly
+like apex's ``send_backward``).  The warmup/cooldown bubbles appear as
+ticks where early/late stages compute on garbage that is masked out —
+the same bubble fraction (S-1)/(M+S-1) as 1F1B.  Scheduling
+(compute/communication overlap) is XLA's latency-hiding scheduler's job;
+memory is bounded by applying ``jax.checkpoint`` to the stage function
+(pass ``remat=True``) instead of 1F1B's early-backward trick.
+
+Interleaved (virtual) pipelining stacks ``v`` model chunks per stage
+(leading axis of the params pytree); an activation traverses logical stage
+``c*S + s`` = chunk ``c`` on device ``s``, hopping device ring each tick and
+advancing chunk on the wrap, reproducing apex's
+``virtual_pipeline_model_parallel_size`` placement.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.parallel_state import PIPELINE_AXIS
+
+
+def _ring_perm(n):
+    return [(i, (i + 1) % n) for i in range(n)]
+
+
+def spmd_pipeline(stage_fn: Callable, params, microbatches, *,
+                  axis_name: str = PIPELINE_AXIS, n_virtual: int = 1,
+                  remat: bool = False):
+    """Run ``M`` microbatches through an ``S``(×``v``)-stage pipeline.
+
+    Must be called inside ``shard_map`` with ``axis_name`` in scope.
+
+    Args:
+      stage_fn: ``(params_chunk, x) -> y`` — this device's stage (or one
+        chunk of it); activation shapes must be uniform across stages.
+      params: stage-local params; with ``n_virtual > 1`` every leaf has a
+        leading ``(n_virtual, ...)`` chunk axis.
+      microbatches: ``(M, ...)`` microbatched activations; only stage 0's
+        value is read (other stages may pass the same array — it arrives
+        replicated from the data loader anyway).
+      remat: rematerialize the stage in backward (activation
+        checkpointing; replaces apex's 1F1B memory policy).
+
+    Returns:
+      ``(M, ...)`` outputs of the final logical stage (meaningful on the
+      last device; other devices hold garbage the caller masks — apex
+      likewise only has losses on the last rank).
+    """
+    S = jax.lax.axis_size(axis_name)
+    s = jax.lax.axis_index(axis_name)
+    M = microbatches.shape[0]
+    v = int(n_virtual)
+    L = S * v
+    T = M + L - 1
+
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn)
+
+    def run_chunks(params, x):
+        # x: (v, mb...) — chunk c's incoming activation
+        if v == 1:
+            return stage_fn(
+                jax.tree_util.tree_map(lambda p: p[0], params),
+                x[0])[None]
+        return jax.vmap(stage_fn)(params, x)
+
+    stacked_params = params
+    if v == 1:
+        stacked_params = jax.tree_util.tree_map(lambda p: p[None],
+                                                params)
+
+    def tick(buf, t):
+        # inject microbatch t at stage 0 chunk 0 (clamped gather is masked
+        # out naturally: those outputs never reach a collected slot)
+        inj = microbatches[jnp.minimum(t, M - 1)]
+        x0 = jnp.where(s == 0, inj, buf[0])
+        x = jnp.concatenate([x0[None], buf[1:]], axis=0) if v > 1 \
+            else x0[None]
+        y = run_chunks(stacked_params, x)
+        # rotate each chunk's output one device forward
+        sent = jax.lax.ppermute(y, axis_name, _ring_perm(S))
+        if v > 1:
+            # on the wrap (stage S-1 → 0) the activation advances a chunk
+            shifted = jnp.concatenate([sent[-1:], sent[:-1]], axis=0)
+            nxt = jnp.where(s == 0, shifted, sent)
+        else:
+            nxt = sent
+        return nxt, y[v - 1]
+
+    buf0 = jnp.zeros((v,) + microbatches.shape[1:], microbatches.dtype)
+    buf0 = jax.lax.pcast(buf0, axis_name, to="varying")
+    _, outs = jax.lax.scan(tick, buf0, jnp.arange(T))
+    # microbatch m leaves the last logical stage at tick m + L - 1
+    return outs[L - 1:]
+
+
+def last_stage_mean_loss(loss_fn, outs, targets, axis_name):
+    """Mean microbatch loss, masked so only the final pipeline stage
+    contributes, psum-replicated across stages (apex: loss lives on the
+    last rank only)."""
+    S = jax.lax.axis_size(axis_name)
+    s = jax.lax.axis_index(axis_name)
+    per = jax.vmap(loss_fn)(outs, targets)
+    local = jnp.mean(per)
+    return jax.lax.psum(jnp.where(s == S - 1, local, 0.0), axis_name)
+
+
+def pipeline_value_and_grad(stage_fn, loss_fn, params, microbatches,
+                            targets, *, axis_name: str = PIPELINE_AXIS,
+                            n_virtual: int = 1, remat: bool = False):
+    """Forward+backward through the pipeline; the workhorse under the apex
+    ``forward_backward_pipelining_*`` schedule functions.
+
+    ``loss_fn(y, target) -> scalar`` runs on the last stage's outputs; the
+    mean over microbatches is psum-masked so only the last stage
+    contributes (apex: loss exists only on the last rank).  Returns
+    ``(mean_loss, grads)`` with grads local to each stage's params.
+    """
+    def total_loss(params):
+        outs = spmd_pipeline(stage_fn, params, microbatches,
+                             axis_name=axis_name, n_virtual=n_virtual,
+                             remat=remat)
+        return last_stage_mean_loss(loss_fn, outs, targets, axis_name)
+
+    return jax.value_and_grad(total_loss)(params)

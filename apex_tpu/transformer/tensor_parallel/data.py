@@ -1,0 +1,34 @@
+"""TPU rebuild of ``apex/transformer/tensor_parallel/data.py``.
+
+Apex broadcasts each batch from TP rank 0 to the group over NCCL
+(``broadcast_data``).  A single-controller JAX program hands every device
+its data through shardings, so broadcast is a replication placement; the
+dtype-checking surface is kept.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from apex_tpu.transformer.parallel_state import get_mesh
+
+_MAX_DATA_DIM = 5
+
+
+def _check_data_types(keys, data, target_dtype):
+    for k in keys:
+        if data[k].dtype != target_dtype:
+            raise AssertionError(
+                f"{k} has data type {data[k].dtype} which "
+                f"is different than {target_dtype}")
+
+
+def broadcast_data(keys, data, datatype):
+    """Replicate ``data[k]`` for each key across the mesh (apex
+    ``broadcast_data``)."""
+    _check_data_types(keys, data, datatype)
+    mesh = get_mesh()
+    repl = NamedSharding(mesh, P())
+    return {k: jax.device_put(jnp.asarray(data[k]), repl) for k in keys}

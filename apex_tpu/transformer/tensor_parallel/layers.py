@@ -1,0 +1,256 @@
+"""Tensor-parallel layers — TPU rebuild of
+``apex/transformer/tensor_parallel/layers.py``.
+
+Megatron TP semantics: ``ColumnParallelLinear`` shards the output dim (weight
+shard ``(out/t, in)``), ``RowParallelLinear`` shards the input dim
+(``(out, in/t)``), ``VocabParallelEmbedding`` shards the vocab rows; the
+fwd/bwd-paired collectives come from ``mappings``.
+
+Two execution modes per layer:
+
+* ``axis_name=None`` — serial reference (full weights), used for parity
+  tests and as the GSPMD form: jit it with the shards given by
+  ``partition_spec()`` and the compiler inserts the same collectives this
+  file writes explicitly (that is the idiomatic TPU path).
+* ``axis_name="model"`` — explicit collectives, for ``shard_map`` training
+  loops; the params passed in are the local shards.
+
+apex's ``linear_with_grad_accumulation_and_async_allreduce`` overlaps the
+input-grad all-reduce with the weight-grad GEMM via CUDA streams; under XLA
+the latency-hiding scheduler performs that overlap on the compiled graph, so
+the function here is the plain mapping composition
+(``gradient_accumulation_fusion``'s fp32 main-grad accumulation is likewise
+an XLA fusion).  ``sequence_parallel_enabled`` swaps the TP-edge collectives
+for the gather/reduce-scatter pair along the sequence (first) dim.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+from apex_tpu.transformer.tensor_parallel import mappings as M
+from apex_tpu.transformer.tensor_parallel.utils import divide, VocabUtility
+
+_f32 = jnp.float32
+
+
+def _normal_init(std=0.02):
+    def init(key, shape, dtype=_f32):
+        return std * jax.random.normal(key, shape, dtype)
+    return init
+
+
+def linear_with_grad_accumulation_and_async_allreduce(
+        x, weight, bias=None, gradient_accumulation_fusion: bool = False,
+        async_grad_allreduce: bool = True,
+        sequence_parallel_enabled: bool = False,
+        axis_name: Optional[str] = TENSOR_AXIS):
+    """Column-parallel matmul with the apex collective pairing.
+
+    ``async_grad_allreduce``/``gradient_accumulation_fusion`` are accepted
+    for parity — overlap and accumulation fusion are compiler-scheduled.
+    """
+    del gradient_accumulation_fusion, async_grad_allreduce
+    if axis_name is not None:
+        if sequence_parallel_enabled:
+            x = M.gather_from_sequence_parallel_region(x, axis_name)
+        else:
+            x = M.copy_to_tensor_model_parallel_region(x, axis_name)
+    y = x @ weight.T
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+class ColumnParallelLinear:
+    """Y = XAᵀ with A sharded over rows (output features).
+
+    Parity: ``ColumnParallelLinear(input_size, output_size, bias,
+    gather_output, init_method, skip_bias_add, no_async_tensor_model_parallel_allreduce,
+    sequence_parallel_enabled, gradient_accumulation_fusion)``.
+    """
+
+    def __init__(self, input_size, output_size, bias=True,
+                 gather_output=True, init_method: Callable = None,
+                 stride=1, keep_master_weight_for_test=False,
+                 skip_bias_add=False,
+                 no_async_tensor_model_parallel_allreduce=False,
+                 sequence_parallel_enabled=False,
+                 gradient_accumulation_fusion=False,
+                 world_size: Optional[int] = None,
+                 axis_name: Optional[str] = TENSOR_AXIS,
+                 param_dtype=_f32):
+        if gather_output and sequence_parallel_enabled:
+            raise RuntimeError(
+                "`gather_output` and `sequence_parallel_enabled` cannot "
+                "both be True")  # apex parity
+        self.input_size = int(input_size)
+        self.output_size = int(output_size)
+        self.use_bias = bool(bias)
+        self.gather_output = bool(gather_output)
+        self.skip_bias_add = bool(skip_bias_add)
+        self.sequence_parallel_enabled = bool(sequence_parallel_enabled)
+        self.axis_name = axis_name
+        self.world_size = int(world_size) if world_size else 1
+        self.output_size_per_partition = divide(self.output_size,
+                                                self.world_size)
+        self.init_method = init_method or _normal_init()
+        self.param_dtype = param_dtype
+
+    def init_params(self, key, partition_rank: Optional[int] = None):
+        """Full weights when ``partition_rank`` is None (serial/GSPMD form);
+        a single local shard otherwise."""
+        out = (self.output_size if partition_rank is None
+               else self.output_size_per_partition)
+        kw, _ = jax.random.split(key)
+        w = self.init_method(kw, (self.output_size, self.input_size),
+                             _f32).astype(self.param_dtype)
+        if partition_rank is not None:
+            w = w[partition_rank * out:(partition_rank + 1) * out]
+        p = {"weight": w}
+        if self.use_bias:
+            p["bias"] = jnp.zeros((out,), self.param_dtype)
+        return p
+
+    def partition_spec(self):
+        """GSPMD shardings: weight rows over the tensor axis."""
+        spec = {"weight": P(TENSOR_AXIS, None)}
+        if self.use_bias:
+            spec["bias"] = P(TENSOR_AXIS)
+        return spec
+
+    def __call__(self, params, x):
+        bias = params.get("bias") if self.use_bias else None
+        y = linear_with_grad_accumulation_and_async_allreduce(
+            x, params["weight"],
+            None if self.skip_bias_add else bias,
+            sequence_parallel_enabled=self.sequence_parallel_enabled,
+            axis_name=self.axis_name)
+        if self.gather_output and self.axis_name is not None:
+            y = M.gather_from_tensor_model_parallel_region(y, self.axis_name)
+        if self.skip_bias_add:
+            return y, bias
+        return y, None
+
+    apply = __call__
+
+
+class RowParallelLinear:
+    """Y = XAᵀ with A sharded over columns (input features)."""
+
+    def __init__(self, input_size, output_size, bias=True,
+                 input_is_parallel=False, init_method: Callable = None,
+                 stride=1, keep_master_weight_for_test=False,
+                 skip_bias_add=False, sequence_parallel_enabled=False,
+                 gradient_accumulation_fusion=False,
+                 world_size: Optional[int] = None,
+                 axis_name: Optional[str] = TENSOR_AXIS,
+                 param_dtype=_f32):
+        if sequence_parallel_enabled and not input_is_parallel:
+            raise RuntimeError(
+                "To enable `sequence_parallel_enabled`, "
+                "`input_is_parallel` must be `True`")  # apex parity
+        self.input_size = int(input_size)
+        self.output_size = int(output_size)
+        self.use_bias = bool(bias)
+        self.input_is_parallel = bool(input_is_parallel)
+        self.skip_bias_add = bool(skip_bias_add)
+        self.sequence_parallel_enabled = bool(sequence_parallel_enabled)
+        self.axis_name = axis_name
+        self.world_size = int(world_size) if world_size else 1
+        self.input_size_per_partition = divide(self.input_size,
+                                               self.world_size)
+        self.init_method = init_method or _normal_init()
+        self.param_dtype = param_dtype
+
+    def init_params(self, key, partition_rank: Optional[int] = None):
+        inp = (self.input_size if partition_rank is None
+               else self.input_size_per_partition)
+        kw, _ = jax.random.split(key)
+        w = self.init_method(kw, (self.output_size, self.input_size),
+                             _f32).astype(self.param_dtype)
+        if partition_rank is not None:
+            w = w[:, partition_rank * inp:(partition_rank + 1) * inp]
+        p = {"weight": w}
+        if self.use_bias:
+            # bias is NOT sharded (applied after the reduce), like apex
+            p["bias"] = jnp.zeros((self.output_size,), self.param_dtype)
+        return p
+
+    def partition_spec(self):
+        spec = {"weight": P(None, TENSOR_AXIS)}
+        if self.use_bias:
+            spec["bias"] = P()
+        return spec
+
+    def __call__(self, params, x):
+        if self.axis_name is not None and not self.input_is_parallel:
+            x = M.scatter_to_tensor_model_parallel_region(x, self.axis_name)
+        y = x @ params["weight"].T
+        if self.axis_name is not None:
+            if self.sequence_parallel_enabled:
+                y = M.reduce_scatter_to_sequence_parallel_region(
+                    y, self.axis_name)
+            else:
+                y = M.reduce_from_tensor_model_parallel_region(
+                    y, self.axis_name)
+        bias = params.get("bias") if self.use_bias else None
+        if self.skip_bias_add:
+            return y, bias
+        if bias is not None:
+            y = y + bias
+        return y, None
+
+    apply = __call__
+
+
+class VocabParallelEmbedding:
+    """Embedding with the vocab dim sharded over the tensor axis."""
+
+    def __init__(self, num_embeddings, embedding_dim,
+                 init_method: Callable = None,
+                 world_size: Optional[int] = None,
+                 axis_name: Optional[str] = TENSOR_AXIS,
+                 param_dtype=_f32):
+        self.num_embeddings = int(num_embeddings)
+        self.embedding_dim = int(embedding_dim)
+        self.axis_name = axis_name
+        self.world_size = int(world_size) if world_size else 1
+        self.num_embeddings_per_partition = divide(self.num_embeddings,
+                                                   self.world_size)
+        self.init_method = init_method or _normal_init()
+        self.param_dtype = param_dtype
+
+    def init_params(self, key, partition_rank: Optional[int] = None):
+        n = (self.num_embeddings if partition_rank is None
+             else self.num_embeddings_per_partition)
+        w = self.init_method(key, (self.num_embeddings, self.embedding_dim),
+                             _f32).astype(self.param_dtype)
+        if partition_rank is not None:
+            w = w[partition_rank * n:(partition_rank + 1) * n]
+        return {"weight": w}
+
+    def partition_spec(self):
+        return {"weight": P(TENSOR_AXIS, None)}
+
+    def __call__(self, params, token_ids):
+        w = params["weight"]
+        if self.axis_name is None:
+            return jnp.take(w, token_ids, axis=0)
+        rank = jax.lax.axis_index(self.axis_name)
+        per = self.num_embeddings_per_partition
+        start = rank * per
+        local = token_ids - start
+        in_range = (local >= 0) & (local < per)
+        local = jnp.where(in_range, local, 0)
+        emb = jnp.take(w, local, axis=0)
+        emb = jnp.where(in_range[..., None], emb, 0.0)
+        return M.reduce_from_tensor_model_parallel_region(emb,
+                                                          self.axis_name)
+
+    apply = __call__

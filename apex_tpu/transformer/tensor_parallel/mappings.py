@@ -1,0 +1,104 @@
+"""TP region mappings — TPU rebuild of
+``apex/transformer/tensor_parallel/mappings.py``.
+
+Each mapping is a forward/backward-paired collective over the ``model`` mesh
+axis, for use inside ``shard_map`` (the explicit-collective expression of
+Megatron TP).  Under pure GSPMD (sharding annotations) these calls are not
+needed — the compiler inserts them — but the explicit forms are the
+load-bearing semantics for the 1:1 apex surface and for tests.
+
+| apex function                                   | fwd            | bwd            |
+|-------------------------------------------------|----------------|----------------|
+| ``copy_to_tensor_model_parallel_region``         | identity       | all-reduce     |
+| ``reduce_from_tensor_model_parallel_region``     | all-reduce     | identity       |
+| ``scatter_to_tensor_model_parallel_region``      | split (last)   | all-gather     |
+| ``gather_from_tensor_model_parallel_region``     | all-gather     | split (last)   |
+| ``scatter_to_sequence_parallel_region``          | split (first)  | all-gather     |
+| ``gather_from_sequence_parallel_region``         | all-gather     | reduce-scatter |
+| ``reduce_scatter_to_sequence_parallel_region``   | reduce-scatter | all-gather     |
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+
+
+from apex_tpu.utils.collectives import ensure_varying as _vary
+
+
+def _reduce(x, axis):
+    return jax.lax.psum(_vary(x, axis), axis)
+
+
+def _split_along_dim(x, dim, axis):
+    n = jax.lax.axis_size(axis)
+    r = jax.lax.axis_index(axis)
+    size = x.shape[dim] // n
+    return jax.lax.dynamic_slice_in_dim(x, r * size, size, axis=dim)
+
+
+def _gather_along_dim(x, dim, axis):
+    return jax.lax.all_gather(_vary(x, axis), axis, axis=dim, tiled=True)
+
+
+def _reduce_scatter_along_dim(x, dim, axis):
+    return jax.lax.psum_scatter(_vary(x, axis), axis, scatter_dimension=dim,
+                                tiled=True)
+
+
+def _mk(name, fwd_fn, bwd_fn):
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+    def f(x, axis=TENSOR_AXIS):
+        return fwd_fn(x, axis)
+
+    def f_fwd(x, axis):
+        return fwd_fn(x, axis), None
+
+    def f_bwd(axis, _, g):
+        return (bwd_fn(g, axis),)
+
+    f.defvjp(f_fwd, f_bwd)
+    f.__name__ = name
+    f.__qualname__ = name
+    return f
+
+
+copy_to_tensor_model_parallel_region = _mk(
+    "copy_to_tensor_model_parallel_region",
+    lambda x, ax: _vary(x, ax),
+    lambda g, ax: _reduce(g, ax))
+
+reduce_from_tensor_model_parallel_region = _mk(
+    "reduce_from_tensor_model_parallel_region",
+    lambda x, ax: _reduce(x, ax),
+    lambda g, ax: _vary(g, ax))
+
+scatter_to_tensor_model_parallel_region = _mk(
+    "scatter_to_tensor_model_parallel_region",
+    lambda x, ax: _split_along_dim(_vary(x, ax), -1, ax),
+    lambda g, ax: _gather_along_dim(g, -1, ax))
+
+gather_from_tensor_model_parallel_region = _mk(
+    "gather_from_tensor_model_parallel_region",
+    lambda x, ax: _gather_along_dim(x, -1, ax),
+    lambda g, ax: _split_along_dim(_vary(g, ax), -1, ax))
+
+scatter_to_sequence_parallel_region = _mk(
+    "scatter_to_sequence_parallel_region",
+    lambda x, ax: _split_along_dim(_vary(x, ax), 0, ax),
+    lambda g, ax: _gather_along_dim(g, 0, ax))
+
+gather_from_sequence_parallel_region = _mk(
+    "gather_from_sequence_parallel_region",
+    lambda x, ax: _gather_along_dim(x, 0, ax),
+    lambda g, ax: _reduce_scatter_along_dim(g, 0, ax))
+
+reduce_scatter_to_sequence_parallel_region = _mk(
+    "reduce_scatter_to_sequence_parallel_region",
+    lambda x, ax: _reduce_scatter_along_dim(x, 0, ax),
+    lambda g, ax: _gather_along_dim(g, 0, ax))

@@ -1,0 +1,93 @@
+"""Activation checkpointing + model-parallel RNG — TPU rebuild of
+``apex/transformer/tensor_parallel/random.py``.
+
+Apex needs a ``CudaRNGStatesTracker`` so dropout inside recomputed
+(checkpointed) regions replays identically, and forks a distinct RNG stream
+per TP rank.  JAX's explicit keys make both disappear by construction:
+
+* recompute determinism — ``jax.checkpoint`` replays the same traced
+  function with the same key;
+* per-rank streams — ``jax.random.fold_in(key, rank)``.
+
+The tracker API is kept as a shim so Megatron-style code paths run.
+``checkpoint`` wraps ``jax.checkpoint``; ``distribute_saved_activations``
+(apex: shard saved activations 1-D across TP ranks) is unnecessary under
+remat — residuals are recomputed, not stored — and is accepted+ignored.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Callable
+
+import jax
+
+from apex_tpu.transformer.parallel_state import (
+    TENSOR_AXIS, get_tensor_model_parallel_rank)
+
+_MODEL_PARALLEL_RNG_TRACKER_NAME = "model-parallel-rng"
+
+
+def model_parallel_rng_key(base_key, axis_name: str = TENSOR_AXIS):
+    """Per-TP-rank key (apex ``model_parallel_cuda_manual_seed``:
+    ``seed + 2718 + tp_rank``)."""
+    try:
+        rank = jax.lax.axis_index(axis_name)
+    except NameError:
+        rank = 0
+    return jax.random.fold_in(base_key, 2718 + rank)
+
+
+def model_parallel_cuda_manual_seed(seed: int):
+    """Returns ``(data_parallel_key, model_parallel_key_fn)`` — the JAX
+    translation of apex's seeding: a shared key for replicated regions and
+    a per-rank folded key for TP regions."""
+    base = jax.random.PRNGKey(seed)
+    return base, lambda axis_name=TENSOR_AXIS: model_parallel_rng_key(
+        base, axis_name)
+
+
+class CudaRNGStatesTracker:
+    """API shim for apex ``CudaRNGStatesTracker`` over JAX keys."""
+
+    def __init__(self):
+        self.states_ = {}
+
+    def reset(self):
+        self.states_ = {}
+
+    def get_states(self):
+        return dict(self.states_)
+
+    def set_states(self, states):
+        self.states_ = dict(states)
+
+    def add(self, name, seed):
+        if name in self.states_:
+            raise Exception(f"cuda rng state {name} already exists")
+        self.states_[name] = jax.random.PRNGKey(seed)
+
+    @contextlib.contextmanager
+    def fork(self, name=_MODEL_PARALLEL_RNG_TRACKER_NAME):
+        """Yield the stream's key and advance it (deterministic fork)."""
+        if name not in self.states_:
+            raise Exception(f"cuda rng state {name} is not added")
+        key, next_key = jax.random.split(self.states_[name])
+        self.states_[name] = next_key
+        yield key
+
+
+_CUDA_RNG_STATE_TRACKER = CudaRNGStatesTracker()
+
+
+def get_cuda_rng_tracker() -> CudaRNGStatesTracker:
+    return _CUDA_RNG_STATE_TRACKER
+
+
+def checkpoint(function: Callable, distribute_saved_activations: bool,
+               *args):
+    """apex ``tensor_parallel.checkpoint``: recompute ``function`` in the
+    backward.  Lowers to ``jax.checkpoint`` (remat); activation sharding is
+    moot under recompute."""
+    del distribute_saved_activations
+    return jax.checkpoint(function)(*args)

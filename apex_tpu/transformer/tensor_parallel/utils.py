@@ -1,0 +1,55 @@
+"""TPU rebuild of ``apex/transformer/tensor_parallel/utils.py``."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def divide(numerator: int, denominator: int) -> int:
+    """Integer division asserting divisibility (apex ``divide``)."""
+    if numerator % denominator != 0:
+        raise ValueError(f"{numerator} is not divisible by {denominator}")
+    return numerator // denominator
+
+
+def split_tensor_along_last_dim(tensor, num_partitions: int,
+                                contiguous_split_chunks: bool = False):
+    """Split along the last dim into ``num_partitions`` chunks."""
+    del contiguous_split_chunks  # always contiguous on TPU
+    size = divide(tensor.shape[-1], num_partitions)
+    return tuple(
+        jnp.take(tensor,
+                 jnp.arange(i * size, (i + 1) * size), axis=-1)
+        for i in range(num_partitions))
+
+
+def split_tensor_into_1d_equal_chunks(tensor, rank: int, world: int):
+    """1-D equal chunk for distributed activation storage (apex
+    ``split_tensor_into_1d_equal_chunks``; functional: rank explicit)."""
+    flat = tensor.reshape(-1)
+    size = divide(flat.shape[0], world)
+    return jax.lax.dynamic_slice_in_dim(flat, rank * size, size)
+
+
+def gather_split_1d_tensor(chunks):
+    """Inverse of the split: concatenate chunks back to one flat tensor."""
+    return jnp.concatenate(list(chunks))
+
+
+class VocabUtility:
+    """Vocab range helpers (apex ``VocabUtility``)."""
+
+    @staticmethod
+    def vocab_range_from_per_partition_vocab_size(per_partition_vocab_size,
+                                                  rank, world_size):
+        f = rank * per_partition_vocab_size
+        return f, f + per_partition_vocab_size
+
+    @staticmethod
+    def vocab_range_from_global_vocab_size(global_vocab_size, rank,
+                                           world_size):
+        per = divide(global_vocab_size, world_size)
+        return VocabUtility.vocab_range_from_per_partition_vocab_size(
+            per, rank, world_size)
+

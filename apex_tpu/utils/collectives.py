@@ -1,0 +1,20 @@
+"""Shared collective helpers."""
+
+from __future__ import annotations
+
+import jax
+
+
+def ensure_varying(x, axis_name):
+    """Idempotently mark ``x`` device-varying over ``axis_name``.
+
+    JAX 0.9 collectives require varying (vma-tracked) inputs inside
+    ``shard_map``; ``pcast`` raises when the value is already varying, so
+    this is the safe form for values of unknown provenance.  Pytree-aware.
+    """
+    def cast(v):
+        try:
+            return jax.lax.pcast(v, axis_name, to="varying")
+        except ValueError:
+            return v
+    return jax.tree_util.tree_map(cast, x)
