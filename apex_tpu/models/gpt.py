@@ -1,0 +1,242 @@
+"""GPT model built from apex_tpu components — the flagship model family
+(reference: ``apex/transformer/testing/standalone_gpt.py``, which wires
+apex's TP layers/fused ops into a Megatron-style GPT for the L0 tests; the
+same wiring here is the production model).
+
+Every compute block is a framework component: VocabParallelEmbedding,
+ColumnParallelLinear/RowParallelLinear (TP + sequence parallel),
+MixedFusedLayerNorm (Pallas), fused RoPE, FusedScaleMaskSoftmax (causal),
+vocab-parallel cross entropy.  One config serves three execution modes:
+
+* serial  — ``tensor_parallel_size=1, axis_name=None`` (tests, single chip)
+* GSPMD   — jit the serial form with ``partition_specs()``
+* shard_map — ``axis_name="model"`` with sharded params; combine with the
+  pipeline engine by stacking layer params per stage.
+
+Activations are ``(batch, seq, hidden)``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.normalization import MixedFusedLayerNorm
+from apex_tpu.ops.rope import fused_apply_rotary_pos_emb_cached, rope_freqs
+from apex_tpu.ops.softmax import scaled_upper_triang_masked_softmax
+from apex_tpu.transformer import tensor_parallel as tp
+
+_f32 = jnp.float32
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_attention_heads: int = 12
+    max_seq_len: int = 1024
+    ffn_hidden_size: Optional[int] = None      # default 4*hidden
+    tensor_parallel_size: int = 1
+    axis_name: Optional[str] = None            # "model" inside shard_map
+    sequence_parallel: bool = False
+    rotary: bool = True
+    dtype: jnp.dtype = jnp.float32             # activation/compute dtype
+    param_dtype: jnp.dtype = jnp.float32
+
+    def __post_init__(self):
+        if self.ffn_hidden_size is None:
+            self.ffn_hidden_size = 4 * self.hidden_size
+        if self.hidden_size % self.num_attention_heads:
+            raise ValueError("hidden_size must divide num_attention_heads")
+        if self.num_attention_heads % self.tensor_parallel_size:
+            raise ValueError("heads must divide tensor_parallel_size")
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def local_heads(self):
+        return self.num_attention_heads // self.tensor_parallel_size
+
+
+class ParallelAttention:
+    """Causal self-attention: TP-sharded QKV/proj, fused RoPE + softmax
+    (apex ``transformer`` attention with FusedScaleMaskSoftmax.causal)."""
+
+    def __init__(self, cfg: GPTConfig):
+        self.cfg = cfg
+        self.qkv = tp.ColumnParallelLinear(
+            cfg.hidden_size, 3 * cfg.hidden_size, gather_output=False,
+            world_size=cfg.tensor_parallel_size, axis_name=cfg.axis_name,
+            sequence_parallel_enabled=cfg.sequence_parallel,
+            param_dtype=cfg.param_dtype)
+        self.proj = tp.RowParallelLinear(
+            cfg.hidden_size, cfg.hidden_size, input_is_parallel=True,
+            world_size=cfg.tensor_parallel_size, axis_name=cfg.axis_name,
+            sequence_parallel_enabled=cfg.sequence_parallel,
+            param_dtype=cfg.param_dtype)
+
+    def init_params(self, key):
+        k1, k2 = jax.random.split(key)
+        return {"qkv": self.qkv.init_params(k1),
+                "proj": self.proj.init_params(k2)}
+
+    def __call__(self, params, x, rope_cos=None, rope_sin=None):
+        cfg = self.cfg
+        b = x.shape[0]
+        qkv, _ = self.qkv(params["qkv"], x)      # (b, s, 3h/t)
+        s = qkv.shape[1]
+        nh = qkv.shape[-1] // (3 * cfg.head_dim)
+        qkv = qkv.reshape(b, s, nh, 3 * cfg.head_dim)
+        q, k, v = jnp.split(qkv, 3, axis=-1)     # (b, s, nh, hd)
+        if rope_cos is not None:
+            # fused rope expects (seq, batch, heads, dim)
+            q = fused_apply_rotary_pos_emb_cached(
+                q.transpose(1, 0, 2, 3), rope_cos, rope_sin
+            ).transpose(1, 0, 2, 3)
+            k = fused_apply_rotary_pos_emb_cached(
+                k.transpose(1, 0, 2, 3), rope_cos, rope_sin
+            ).transpose(1, 0, 2, 3)
+        # (b, nh, s, hd)
+        q = q.transpose(0, 2, 1, 3)
+        k = k.transpose(0, 2, 1, 3)
+        v = v.transpose(0, 2, 1, 3)
+        scale = 1.0 / jnp.sqrt(cfg.head_dim).astype(_f32)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                            preferred_element_type=_f32)
+        probs = scaled_upper_triang_masked_softmax(
+            scores.reshape(b * nh, s, s), float(scale))
+        probs = probs.reshape(b, nh, s, s).astype(v.dtype)
+        ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, nh * cfg.head_dim)
+        out, _ = self.proj(params["proj"], ctx)
+        return out
+
+
+class ParallelMLP:
+    """Column→GELU→Row block (apex ParallelMLP)."""
+
+    def __init__(self, cfg: GPTConfig):
+        self.cfg = cfg
+        self.fc1 = tp.ColumnParallelLinear(
+            cfg.hidden_size, cfg.ffn_hidden_size, gather_output=False,
+            world_size=cfg.tensor_parallel_size, axis_name=cfg.axis_name,
+            sequence_parallel_enabled=cfg.sequence_parallel,
+            param_dtype=cfg.param_dtype)
+        self.fc2 = tp.RowParallelLinear(
+            cfg.ffn_hidden_size, cfg.hidden_size, input_is_parallel=True,
+            world_size=cfg.tensor_parallel_size, axis_name=cfg.axis_name,
+            sequence_parallel_enabled=cfg.sequence_parallel,
+            param_dtype=cfg.param_dtype)
+
+    def init_params(self, key):
+        k1, k2 = jax.random.split(key)
+        return {"fc1": self.fc1.init_params(k1),
+                "fc2": self.fc2.init_params(k2)}
+
+    def __call__(self, params, x):
+        h, _ = self.fc1(params["fc1"], x)
+        h = jax.nn.gelu(h, approximate=True)
+        y, _ = self.fc2(params["fc2"], h)
+        return y
+
+
+class ParallelTransformerLayer:
+    """Pre-LN transformer block (apex ParallelTransformerLayer)."""
+
+    def __init__(self, cfg: GPTConfig):
+        self.cfg = cfg
+        self.input_layernorm = MixedFusedLayerNorm(cfg.hidden_size)
+        self.post_attention_layernorm = MixedFusedLayerNorm(cfg.hidden_size)
+        self.attention = ParallelAttention(cfg)
+        self.mlp = ParallelMLP(cfg)
+
+    def init_params(self, key):
+        k1, k2 = jax.random.split(key)
+        return {"input_layernorm": self.input_layernorm.init_params(),
+                "attention": self.attention.init_params(k1),
+                "post_attention_layernorm":
+                    self.post_attention_layernorm.init_params(),
+                "mlp": self.mlp.init_params(k2)}
+
+    def __call__(self, params, x, rope_cos=None, rope_sin=None):
+        h = self.input_layernorm(params["input_layernorm"], x)
+        x = x + self.attention(params["attention"], h, rope_cos, rope_sin)
+        h = self.post_attention_layernorm(params["post_attention_layernorm"],
+                                          x)
+        return x + self.mlp(params["mlp"], h)
+
+
+class GPTModel:
+    """Full decoder LM: vocab-parallel embedding → N layers → final LN →
+    tied vocab-parallel head → (optional) vocab-parallel xent loss."""
+
+    def __init__(self, cfg: GPTConfig):
+        self.cfg = cfg
+        self.embedding = tp.VocabParallelEmbedding(
+            cfg.vocab_size, cfg.hidden_size,
+            world_size=cfg.tensor_parallel_size, axis_name=cfg.axis_name,
+            param_dtype=cfg.param_dtype)
+        self.layers = [ParallelTransformerLayer(cfg)
+                       for _ in range(cfg.num_layers)]
+        self.final_layernorm = MixedFusedLayerNorm(cfg.hidden_size)
+
+    def init_params(self, key):
+        keys = jax.random.split(key, self.cfg.num_layers + 2)
+        params = {
+            "embedding": self.embedding.init_params(keys[0]),
+            "layers": [l.init_params(k)
+                       for l, k in zip(self.layers, keys[1:-1])],
+            "final_layernorm": self.final_layernorm.init_params(),
+        }
+        if not self.cfg.rotary:
+            params["position_embedding"] = 0.02 * jax.random.normal(
+                keys[-1], (self.cfg.max_seq_len, self.cfg.hidden_size),
+                self.cfg.param_dtype)
+        return params
+
+    def rope_tables(self, seq_len):
+        if not self.cfg.rotary:
+            return None, None
+        f = rope_freqs(seq_len, self.cfg.head_dim)
+        return jnp.cos(f), jnp.sin(f)
+
+    def embed(self, params, tokens):
+        x = self.embedding(params["embedding"], tokens)
+        if not self.cfg.rotary:
+            x = x + params["position_embedding"][:tokens.shape[1]]
+        return x.astype(self.cfg.dtype)
+
+    def backbone(self, params, x, seq_len=None):
+        cos, sin = self.rope_tables(seq_len or x.shape[1])
+        for layer, lp in zip(self.layers, params["layers"]):
+            x = layer(lp, x, cos, sin)
+        return x
+
+    def logits(self, params, x):
+        """Tied LM head: vocab-parallel logits ``(b, s, vocab/t)``."""
+        x = self.final_layernorm(params["final_layernorm"], x)
+        w = params["embedding"]["weight"]
+        return jnp.einsum("bsh,vh->bsv", x.astype(_f32),
+                          w.astype(_f32))
+
+    def __call__(self, params, tokens):
+        x = self.embed(params, tokens)
+        x = self.backbone(params, x)
+        return self.logits(params, x)
+
+    apply = __call__
+
+    def loss(self, params, tokens, targets):
+        """Mean next-token loss via vocab-parallel cross entropy."""
+        logits = self(params, tokens)
+        b, s, vl = logits.shape
+        per = tp.vocab_parallel_cross_entropy(
+            logits.reshape(b * s, vl), targets.reshape(b * s),
+            axis_name=self.cfg.axis_name)
+        return jnp.mean(per)
