@@ -51,9 +51,12 @@ class GPTConfig:
         if self.ffn_hidden_size is None:
             self.ffn_hidden_size = 4 * self.hidden_size
         if self.hidden_size % self.num_attention_heads:
-            raise ValueError("hidden_size must divide num_attention_heads")
+            raise ValueError(
+                "hidden_size must be divisible by num_attention_heads")
         if self.num_attention_heads % self.tensor_parallel_size:
-            raise ValueError("heads must divide tensor_parallel_size")
+            raise ValueError(
+                "num_attention_heads must be divisible by "
+                "tensor_parallel_size")
 
     @property
     def head_dim(self):

@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from apex_tpu.utils.collectives import psum_if_varying
+
 DEFAULT_DATA_AXIS = "data"
 
 
@@ -47,8 +49,17 @@ def allreduce_gradients(grads, axis_name: str = DEFAULT_DATA_AXIS,
     pytree (XLA concatenates it into large transfers — the moral equivalent
     of apex's flatten+bucket).  ``average=True`` mirrors apex's
     ``gradient_average`` (divide by world size).
+
+    Leaves that are already device-invariant over a ``shard_map`` axis are
+    treated as already-summed gradients (JAX auto-psums grads of replicated
+    params): the psum is skipped but averaging still divides by world size.
+    This is a gradient-reduction helper, not a general replicated-value
+    allreduce.
     """
-    reduced = jax.lax.psum(grads, axis_name)
+    # Grads computed without mark_local arrive device-INVARIANT — JAX 0.9
+    # auto-psummed them during grad-of-replicated-params — and psumming
+    # again would multiply by axis size.  Reduce only the varying leaves.
+    reduced = psum_if_varying(grads, axis_name)
     if average:
         n = jax.lax.axis_size(axis_name)
         reduced = jax.tree_util.tree_map(lambda g: g / n, reduced)
@@ -152,13 +163,16 @@ class DistributedDataParallel:
             grads = jax.tree_util.tree_map(
                 lambda g: g.astype(jnp.float32), grads)
         factor = self.gradient_predivide_factor
-        if self.gradient_average and factor != 1.0:
-            # apex staging: divide by `factor` before the reduce and by
-            # `world/factor` after (spreads the scaling for fp16 safety)
+        if factor != 1.0:
+            # apex staging: divide by `factor` before the reduce
+            # unconditionally (fp16 overflow safety), then by `world/factor`
+            # after only when averaging — net sum/factor otherwise.
             grads = jax.tree_util.tree_map(lambda g: g / factor, grads)
-            out = jax.lax.psum(grads, self.axis_name)
-            n = jax.lax.axis_size(self.axis_name)
-            return jax.tree_util.tree_map(lambda g: g * (factor / n), out)
+            out = psum_if_varying(grads, self.axis_name)
+            if self.gradient_average:
+                n = jax.lax.axis_size(self.axis_name)
+                out = jax.tree_util.tree_map(lambda g: g * (factor / n), out)
+            return out
         return allreduce_gradients(grads, self.axis_name,
                                    average=self.gradient_average)
 

@@ -41,12 +41,15 @@ def _axis_reduce(total, axis_name):
 def sync_batch_norm(x, weight, bias, state: BatchNormState, *,
                     training: bool, momentum: float = 0.1, eps: float = 1e-5,
                     axis_name: Optional[str] = None,
-                    channel_last: bool = False):
+                    channel_last: bool = False,
+                    update_running_stats: bool = True):
     """Functional SyncBatchNorm.  Returns ``(y, new_state)``.
 
     In training mode, batch stats combine across ``axis_name`` (the
     ``process_group`` analogue); running stats update with the *unbiased*
-    variance like torch/apex.
+    variance like torch/apex.  ``update_running_stats=False`` still
+    normalizes with batch statistics in training mode (torch semantics for
+    ``track_running_stats=False``) but leaves ``state`` untouched.
     """
     c_axis = x.ndim - 1 if channel_last else 1
     red_axes = tuple(i for i in range(x.ndim) if i != c_axis)
@@ -66,10 +69,13 @@ def sync_batch_norm(x, weight, bias, state: BatchNormState, *,
         mean = total[0] / count
         var = total[1] / count - mean * mean          # biased (normalization)
         unbiased = var * (count / max(count - 1.0, 1.0))
-        new_state = BatchNormState(
-            (1 - momentum) * state.running_mean + momentum * mean,
-            (1 - momentum) * state.running_var + momentum * unbiased,
-            state.num_batches_tracked + 1)
+        if update_running_stats:
+            new_state = BatchNormState(
+                (1 - momentum) * state.running_mean + momentum * mean,
+                (1 - momentum) * state.running_var + momentum * unbiased,
+                state.num_batches_tracked + 1)
+        else:
+            new_state = state
     else:
         mean, var = state.running_mean, state.running_var
         new_state = state
@@ -111,12 +117,16 @@ class SyncBatchNorm:
                               jnp.zeros((), jnp.int32))
 
     def __call__(self, params, state, x, training: bool = True):
+        # torch semantics: with track_running_stats=False there are no
+        # running stats to fall back on, so batch statistics are used in
+        # BOTH train and eval mode (and never written back).
         y, new_state = sync_batch_norm(
             x, params.get("weight") if self.affine else None,
             params.get("bias") if self.affine else None,
-            state, training=training and self.track_running_stats,
+            state, training=training or not self.track_running_stats,
             momentum=self.momentum, eps=self.eps, axis_name=self.axis_name,
-            channel_last=self.channel_last)
+            channel_last=self.channel_last,
+            update_running_stats=self.track_running_stats)
         if self.fuse_relu:
             y = jax.nn.relu(y)
         return y, new_state

@@ -73,6 +73,12 @@ def _vp_xent_fwd(logits, target, label_smoothing, axis_name):
     if label_smoothing > 0.0:
         # apex scales the mix: s_adj = s * V/(V-1), then
         # loss = (1-s_adj)*nll + s_adj * mean_i(log_z - logit_i)
+        # INTENTIONAL DEVIATION from apex/Megatron for TP>1: the reference
+        # forward averages logits over the LOCAL vocab shard only
+        # (inconsistent with its own backward, which smooths over the full
+        # vocab); here the mean is over the GLOBAL vocab (psum of shard
+        # sums / full V), making fwd and bwd self-consistent.  Loss values
+        # therefore differ from the reference when tp>1 and smoothing>0.
         assert 1.0 > label_smoothing > 0.0, label_smoothing
         vocab = partition_vocab * world if axis_name is not None else \
             partition_vocab

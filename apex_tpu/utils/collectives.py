@@ -18,3 +18,35 @@ def ensure_varying(x, axis_name):
         except ValueError:
             return v
     return jax.tree_util.tree_map(cast, x)
+
+
+def is_varying(x, axis_name) -> bool:
+    """True if ``x`` is device-varying over ``axis_name`` (JAX 0.9 vma
+    tracking).  vma only exists for ``shard_map`` *manual* mesh axes; for a
+    vmap/pmap axis (or outside any trace) the notion doesn't apply, so
+    report True and let callers fall through to the normal collective."""
+    try:
+        manual = jax.sharding.get_abstract_mesh().manual_axes
+    except (AttributeError, TypeError):
+        return True
+    if axis_name not in manual:
+        return True
+    return axis_name in jax.typeof(x).vma
+
+
+def psum_if_varying(tree, axis_name):
+    """``psum`` only the leaves that are actually device-varying.
+
+    An *invariant* leaf inside ``shard_map`` holds the same value on every
+    device — for gradients that means it was already cross-device reduced
+    (JAX auto-psums grads of replicated inputs), and psumming it again
+    would multiply by axis size.  Such leaves pass through unchanged,
+    treated as ALREADY-SUMMED: callers that average afterwards still divide
+    them by axis size.  Pass a value that is replicated-but-not-a-sum and
+    that division is wrong — these helpers are for gradients.
+    """
+    def one(v):
+        if is_varying(v, axis_name):
+            return jax.lax.psum(v, axis_name)
+        return v
+    return jax.tree_util.tree_map(one, tree)

@@ -75,6 +75,40 @@ class TestDDP:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-5, atol=1e-6)
 
+    def test_reduce_of_invariant_grads_no_double_count(self, rng, mesh):
+        """Grads computed WITHOUT mark_local come out device-invariant
+        (jax.grad already psummed them); reduce() must not multiply them by
+        world size again (JAX 0.9 vma regression)."""
+        params = {"w": jnp.asarray(rng.randn(8, 2).astype(np.float32)),
+                  "b": jnp.zeros((2,), jnp.float32)}
+        x = jnp.asarray(rng.randn(32, 8).astype(np.float32))
+        y = jnp.asarray(rng.randn(32, 2).astype(np.float32))
+        ddp = DistributedDataParallel(mesh=mesh)
+
+        @jax.jit
+        def run(params, x, y):
+            def step(params, x, y):
+                g = jax.grad(loss_fn)(params, x, y)  # invariant (auto-psum)
+                return ddp.reduce(g)
+            return shard_map(step, mesh=mesh,
+                             in_specs=(P(), P("data"), P("data")),
+                             out_specs=P())(params, x, y)
+
+        got = run(params, x, y)["w"]
+        # auto-psum sums the 8 per-shard mean-grads; average divides by 8,
+        # recovering the full-batch grad — NOT 8x it.
+        ref = jax.grad(loss_fn)(params, x, y)["w"]
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_allreduce_under_vmap_axis(self):
+        """vmap axes have no vma tracking; the invariant-skip must not
+        fire there — psum runs normally."""
+        out = jax.vmap(lambda g: allreduce_gradients(g, "data",
+                                                     average=False),
+                       axis_name="data")(jnp.arange(4.0))
+        np.testing.assert_allclose(np.asarray(out), 6.0)
+
     def test_gradient_average_off(self, rng, mesh):
         params = {"w": jnp.ones((4, 2), jnp.float32)}
         grads = {"w": jnp.ones((8, 4, 2), jnp.float32)}  # per-device stack
@@ -155,6 +189,23 @@ class TestSyncBatchNorm:
         # (0 - mean)/2
         np.testing.assert_allclose(np.asarray(y[0, :, 0, 0]),
                                    [-0.5, -1.0, -1.5], rtol=1e-5)
+
+    def test_no_track_running_stats_uses_batch_stats(self, rng):
+        """track_running_stats=False in training: normalize with BATCH
+        stats (torch/apex semantics), state untouched."""
+        x = jnp.asarray(rng.randn(16, 4, 3, 3).astype(np.float32))
+        bn = SyncBatchNorm(4, track_running_stats=False)
+        params, state = bn.init_params(), bn.init_state()
+        y, st = bn(params, state, x, training=True)
+        m = np.asarray(y).transpose(0, 2, 3, 1).reshape(-1, 4).mean(0)
+        np.testing.assert_allclose(m, 0.0, atol=1e-5)  # batch-normalized
+        np.testing.assert_allclose(np.asarray(st.running_mean),
+                                   np.asarray(state.running_mean))
+        assert int(st.num_batches_tracked) == 0
+        # eval mode: torch still uses BATCH stats when not tracking
+        y_ev, _ = bn(params, state, x, training=False)
+        m_ev = np.asarray(y_ev).transpose(0, 2, 3, 1).reshape(-1, 4).mean(0)
+        np.testing.assert_allclose(m_ev, 0.0, atol=1e-5)
 
     def test_channel_last(self, rng):
         x = jnp.asarray(rng.randn(8, 4, 4, 6).astype(np.float32))
