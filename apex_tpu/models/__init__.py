@@ -1,0 +1,5 @@
+"""Flagship model families built from apex_tpu components (reference:
+``apex/transformer/testing/standalone_gpt.py`` / ``standalone_bert.py`` —
+test-only toys upstream, production models here)."""
+
+from apex_tpu.models import gpt  # noqa: F401

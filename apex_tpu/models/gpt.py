@@ -109,11 +109,11 @@ class ParallelAttention:
         q = q.transpose(0, 2, 1, 3)
         k = k.transpose(0, 2, 1, 3)
         v = v.transpose(0, 2, 1, 3)
-        scale = 1.0 / jnp.sqrt(cfg.head_dim).astype(_f32)
+        scale = 1.0 / float(cfg.head_dim) ** 0.5
         scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                             preferred_element_type=_f32)
         probs = scaled_upper_triang_masked_softmax(
-            scores.reshape(b * nh, s, s), float(scale))
+            scores.reshape(b * nh, s, s), scale)
         probs = probs.reshape(b, nh, s, s).astype(v.dtype)
         ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
         ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, nh * cfg.head_dim)
@@ -243,3 +243,243 @@ class GPTModel:
             logits.reshape(b * s, vl), targets.reshape(b * s),
             axis_name=self.cfg.axis_name)
         return jnp.mean(per)
+
+    # -- GSPMD form ---------------------------------------------------------
+
+    def partition_specs(self):
+        """PartitionSpecs for jitting the serial form under GSPMD: the
+        compiler inserts the same collectives the shard_map form writes
+        explicitly (the idiomatic TPU path)."""
+        from jax.sharding import PartitionSpec as P
+        layer_spec = {
+            "input_layernorm": {"weight": P(), "bias": P()},
+            "attention": {"qkv": self.layers[0].attention.qkv
+                          .partition_spec(),
+                          "proj": self.layers[0].attention.proj
+                          .partition_spec()},
+            "post_attention_layernorm": {"weight": P(), "bias": P()},
+            "mlp": {"fc1": self.layers[0].mlp.fc1.partition_spec(),
+                    "fc2": self.layers[0].mlp.fc2.partition_spec()},
+        }
+        spec = {
+            "embedding": self.embedding.partition_spec(),
+            "layers": [layer_spec] * self.cfg.num_layers,
+            "final_layernorm": {"weight": P(), "bias": P()},
+        }
+        if not self.cfg.rotary:
+            spec["position_embedding"] = P()
+        return spec
+
+
+def shard_params_for_tp(cfg: GPTConfig, params, rank: int):
+    """Slice full (serial-init) GPT params into tensor-parallel rank
+    ``rank``'s local shards, matching the layer shardings
+    (Column: row-block of weight/bias; Row: column-block of weight,
+    replicated bias; vocab embedding: row-block).  Test/checkpoint-resharding
+    utility — the shard_map form consumes these shards directly."""
+    t = cfg.tensor_parallel_size
+
+    def col(w):      # ColumnParallel weight/bias: shard dim 0
+        per = w.shape[0] // t
+        return w[rank * per:(rank + 1) * per]
+
+    def row(w):      # RowParallel weight: shard dim 1
+        per = w.shape[1] // t
+        return w[:, rank * per:(rank + 1) * per]
+
+    out = {"embedding": {"weight": col(params["embedding"]["weight"])},
+           "final_layernorm": params["final_layernorm"],
+           "layers": []}
+    if "position_embedding" in params:
+        out["position_embedding"] = params["position_embedding"]
+    for lp in params["layers"]:
+        out["layers"].append({
+            "input_layernorm": lp["input_layernorm"],
+            "post_attention_layernorm": lp["post_attention_layernorm"],
+            "attention": {
+                "qkv": {"weight": col(lp["attention"]["qkv"]["weight"]),
+                        "bias": col(lp["attention"]["qkv"]["bias"])},
+                "proj": {"weight": row(lp["attention"]["proj"]["weight"]),
+                         "bias": lp["attention"]["proj"]["bias"]},
+            },
+            "mlp": {
+                "fc1": {"weight": col(lp["mlp"]["fc1"]["weight"]),
+                        "bias": col(lp["mlp"]["fc1"]["bias"])},
+                "fc2": {"weight": row(lp["mlp"]["fc2"]["weight"]),
+                        "bias": lp["mlp"]["fc2"]["bias"]},
+            },
+        })
+    return out
+
+
+def _is_spec_leaf(x):
+    from jax.sharding import PartitionSpec
+    return isinstance(x, PartitionSpec)
+
+
+def _is_sharded(spec) -> bool:
+    return any(a is not None for a in spec)
+
+
+def pack_for_shard_map(model: GPTModel, params, n_stages: Optional[int] = None,
+                       tensor_axis: str = "model", pipe_axis: str = "pipe"):
+    """Pack serial-init GPT params for an explicit ``shard_map`` step.
+
+    TP-sharded leaves (per :meth:`GPTModel.partition_specs`) are stacked
+    along a new leading ``(tp,)`` axis to be split by the mesh; replicated
+    leaves pass through whole so they stay device-INVARIANT inside
+    ``shard_map`` — that is load-bearing for gradients: the cotangent of a
+    replicated param is split arbitrarily across devices by the backward
+    collectives, and only JAX's automatic psum-of-invariant-grads restores
+    the total.  With ``n_stages`` the layer stack is additionally split
+    over the pipe axis (:func:`stack_layers_for_pipeline`).
+
+    Returns ``(packed, in_specs, local_fn, repack_fn)``:
+    ``local_fn`` strips the unit mesh axes inside ``shard_map`` to yield
+    the per-device params :class:`GPTModel`/:func:`pipeline_loss` consume;
+    ``repack_fn`` is its inverse for gradient pytrees (so ``out_specs`` can
+    reuse ``in_specs``).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    cfg = model.cfg
+    tp = cfg.tensor_parallel_size
+    shards = [shard_params_for_tp(cfg, params, r) for r in range(tp)]
+    if n_stages is not None:
+        for sh in shards:
+            sh["layers"] = stack_layers_for_pipeline(sh["layers"], n_stages)
+    specs = model.partition_specs()
+    if n_stages is not None:
+        specs = dict(specs, layers=specs["layers"][0])
+
+    def tmap(fn, *trees):
+        return jax.tree_util.tree_map(fn, specs, *trees,
+                                      is_leaf=_is_spec_leaf)
+
+    packed = tmap(lambda s, *xs: jnp.stack(xs) if _is_sharded(s) else xs[0],
+                  *shards)
+
+    def path_aware(fn):
+        # layer leaves carry the extra pipe axis when pipelined
+        def run(tree):
+            out = {}
+            for key, sub in tree.items():
+                in_layers = (key == "layers" and n_stages is not None)
+                out[key] = jax.tree_util.tree_map(
+                    lambda s, x: fn(s, x, in_layers), specs[key], sub,
+                    is_leaf=_is_spec_leaf)
+            return out
+        return run
+
+    in_specs = path_aware(
+        lambda s, x, lay: (P(tensor_axis, pipe_axis) if _is_sharded(s)
+                           else P(pipe_axis)) if lay
+        else (P(tensor_axis) if _is_sharded(s) else P()))(packed)
+
+    local_fn = path_aware(
+        lambda s, x, lay: (x[0, 0] if _is_sharded(s) else x[0]) if lay
+        else (x[0] if _is_sharded(s) else x))
+
+    repack_fn = path_aware(
+        lambda s, g, lay: (g[None, None] if _is_sharded(s) else g[None])
+        if lay else (g[None] if _is_sharded(s) else g))
+
+    return packed, in_specs, local_fn, repack_fn
+
+
+# -- pipeline composition ----------------------------------------------------
+
+def stack_layers_for_pipeline(layer_params, n_stages: int):
+    """Split per-layer params into ``n_stages`` contiguous stage stacks.
+
+    ``layer_params`` is the ``params["layers"]`` list; returns a pytree
+    whose leaves have shape ``(n_stages, layers_per_stage, ...)`` — shard
+    the leading axis over the pipe mesh axis (``in_specs`` leading
+    ``P("pipe", ...)``), drop the unit axis inside ``shard_map``, and each
+    stage holds exactly its contiguous block of layers (apex: layer ranges
+    assigned per pipeline rank).
+    """
+    n_layers = len(layer_params)
+    if n_layers % n_stages:
+        raise ValueError(
+            f"num_layers ({n_layers}) must be divisible by the number of "
+            f"pipeline stages ({n_stages})")
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                                     *layer_params)
+    return jax.tree_util.tree_map(
+        lambda x: x.reshape((n_stages, n_layers // n_stages) + x.shape[1:]),
+        stacked)
+
+
+def make_stage_fn(model: GPTModel):
+    """Build the pipeline ``stage_fn``: scan this stage's stacked layer
+    params over the activation (``(mb, s, h) -> (mb, s, h)``)."""
+    layer = model.layers[0]       # all layers share the module config
+
+    def stage_fn(stage_params, x):
+        cos, sin = model.rope_tables(x.shape[1])
+
+        def body(h, lp):
+            return layer(lp, h, cos, sin), None
+
+        y, _ = jax.lax.scan(body, x, stage_params)
+        return y
+
+    return stage_fn
+
+
+def pipeline_loss(model: GPTModel, params, tokens, targets, *,
+                  pipe_axis: str = "pipe", data_axis: Optional[str] = None,
+                  n_virtual: int = 1, remat: bool = False):
+    """GPT training loss over the SPMD pipeline — call inside ``shard_map``.
+
+    ``params["layers"]`` holds this stage's stacked layers (leaves
+    ``(layers_per_stage, ...)`` from :func:`stack_layers_for_pipeline`);
+    embedding/final-LN params are replicated over the pipe axis.  ``tokens``
+    / ``targets`` are ``(M, mb, s)`` local microbatches.  Embedding and the
+    tied head run on every stage (SPMD), but only stage 0's embedding
+    output is injected into the pipeline and only the last stage's head
+    loss survives the mask, so the auto-psum of replicated-param grads over
+    the pipe axis yields exactly the apex first/last-rank gradients.
+    """
+    from apex_tpu.transformer.pipeline_parallel.spmd import (
+        spmd_pipeline, last_stage_mean_loss)
+
+    # Mark every param leaf device-varying over the pipe (and data) axes:
+    # pcast's transpose is a psum over the added axes, so grads of
+    # pipe-replicated leaves come back fully reduced and invariant — which
+    # also keeps the grad vma statically exact for shard_map's out_specs
+    # (the stage-masked loss otherwise defeats the auto-psum inference).
+    # The TP axis must NOT be added: the Megatron mappings' custom_vjp
+    # rules are written against the model-invariant contract (psum outputs
+    # stay invariant), and promoting replicated params to model-varying
+    # inserts implicit pcasts whose transposes double-reduce the custom
+    # rules' cotangents.  Model-axis grad reduction is JAX's auto-psum of
+    # invariant-input grads, exactly as in the non-pipelined TP path.
+    axes = {pipe_axis}
+    if data_axis is not None:
+        axes.add(data_axis)
+
+    def _vary(p):
+        missing = tuple(axes - set(jax.typeof(p).vma))
+        return jax.lax.pcast(p, missing, to="varying") if missing else p
+
+    params = jax.tree_util.tree_map(_vary, params)
+
+    x = _vary(jax.vmap(lambda t: model.embed(params, t))(tokens))
+    outs = spmd_pipeline(make_stage_fn(model), params["layers"], x,
+                         axis_name=pipe_axis, n_virtual=n_virtual,
+                         remat=remat)
+
+    def head(y, t):
+        logits = model.logits(params, y)
+        mb, s, vl = logits.shape
+        per = tp.vocab_parallel_cross_entropy(
+            logits.reshape(mb * s, vl), t.reshape(mb * s),
+            axis_name=model.cfg.axis_name)
+        return jnp.mean(per)
+
+    loss = last_stage_mean_loss(head, outs, targets, pipe_axis)
+    if data_axis is not None:
+        loss = jax.lax.pmean(loss, data_axis)
+    return loss

@@ -83,6 +83,19 @@ def spmd_pipeline(stage_fn: Callable, params, microbatches, *,
         stacked_params = jax.tree_util.tree_map(lambda p: p[None],
                                                 params)
 
+    # Make every param leaf varying over the activation axes (e.g. the
+    # data axis in a dp x pp mesh): the backward scan's param-cotangent
+    # carries are varying over those axes, and JAX 0.9 requires carry vma
+    # to match.  pcast's transpose is a psum over the added axes, which is
+    # exactly the cross-device grad accumulation those params need.
+    act_vma = set(jax.typeof(microbatches).vma) | {axis_name}
+
+    def _vary(p):
+        missing = tuple(act_vma - set(jax.typeof(p).vma))
+        return jax.lax.pcast(p, missing, to="varying") if missing else p
+
+    stacked_params = jax.tree_util.tree_map(_vary, stacked_params)
+
     def tick(buf, t):
         # inject microbatch t at stage 0 chunk 0 (clamped gather is masked
         # out naturally: those outputs never reach a collected slot)
@@ -102,7 +115,11 @@ def spmd_pipeline(stage_fn: Callable, params, microbatches, *,
         return nxt, y[v - 1]
 
     buf0 = jnp.zeros((v,) + microbatches.shape[1:], microbatches.dtype)
-    buf0 = jax.lax.pcast(buf0, axis_name, to="varying")
+    # the scan carry must be varying over the pipe axis AND every axis the
+    # microbatches vary over (e.g. the data axis in a dp x pp mesh), or the
+    # carry types won't match the tick output under JAX 0.9 vma tracking
+    vma = set(jax.typeof(microbatches).vma) | {axis_name}
+    buf0 = jax.lax.pcast(buf0, tuple(vma), to="varying")
     _, outs = jax.lax.scan(tick, buf0, jnp.arange(T))
     # microbatch m leaves the last logical stage at tick m + L - 1
     return outs[L - 1:]
