@@ -32,7 +32,11 @@ from apex_tpu.multi_tensor_apply.bucketing import LANE, _round_up
 from apex_tpu.utils.platform import interpret_mode, use_pallas
 
 _f32 = jnp.float32
-_VMEM_BUDGET = 4 * 1024 * 1024  # bytes per operand block
+# Per-operand block budget.  The bwd kernel materializes ~10 f32
+# block-sized temporaries on Mosaic's scoped-vmem stack (16 MB limit), so
+# the per-operand budget must stay well under limit/10 — 4 MB blocks OOM
+# the scoped stack at hidden=1024 on v5e.
+_VMEM_BUDGET = 1024 * 1024  # bytes per operand block
 
 
 def _pick_block_rows(hidden_p: int) -> int:
