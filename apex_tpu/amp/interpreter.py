@@ -11,10 +11,17 @@ differentiates through the inserted casts exactly as torch autograd does for
 apex's forward-inserted casts.
 
 Higher-order primitives: ``pjit``/``closed_call``/``remat`` bodies are
-recursed into; control-flow and custom-derivative calls
-(``scan``/``while``/``cond``/``custom_jvp_call``/``custom_vjp_call``) are
-left intact with their inputs restored to the traced dtypes — casting across
-a loop-carry boundary would change carry dtypes mid-loop.
+recursed into; control-flow (``scan``/``while``/``cond``) is left intact
+with inputs restored to the traced dtypes — casting across a loop-carry
+boundary would change carry dtypes mid-loop.  Custom-derivative calls
+(``custom_jvp_call``/``custom_vjp_call``) are OPAQUE: inputs are restored
+to the traced dtypes and the call is re-bound through
+``primitive.get_bind_params`` (the ``core.eval_jaxpr`` mechanism), so the
+author's derivative rule survives — required for the library's own Pallas
+ops, whose bodies (bare ``pallas_call``) have no autodiff rule to inline
+into.  This matches apex O1 semantics: amp patches the *functional
+surface*, and the interior of a ``torch.autograd.Function`` is never
+patched either.
 """
 
 from __future__ import annotations
@@ -35,11 +42,9 @@ from apex_tpu.amp.lists import classify
 
 _RECURSE = {"pjit", "jit", "closed_call", "core_call", "remat", "remat2",
             "checkpoint"}
-# custom-derivative calls can't be re-bound from their eqn params (the
-# callables aren't serialized there) — inline their call_jaxpr instead.
-# The custom rule is lost under the interpreter; standard autodiff of the
-# inlined body applies, which matches apex O1 (patched ops are plain ops).
-_INLINE_CALL = {"custom_jvp_call", "custom_vjp_call",
+# custom-derivative calls are re-bound whole (dtypes restored at the
+# boundary) so the custom rule survives for the backward pass
+_CUSTOM_CALL = {"custom_jvp_call", "custom_vjp_call",
                 "custom_jvp_call_jaxpr", "custom_vjp_call_jaxpr",
                 "custom_jvp_generic_call", "custom_lin"}
 _RESTORE_DTYPES = {"scan", "while", "cond"}
@@ -80,14 +85,11 @@ def _eval_jaxpr(jaxpr, consts, args, compute_dtype):
         invals = _safe_map(read, eqn.invars)
         name = eqn.primitive.name
         params = eqn.params
-        if name in _INLINE_CALL and "call_jaxpr" in params:
-            inner = params["call_jaxpr"]
-            inner_jaxpr = inner.jaxpr if hasattr(inner, "jaxpr") else inner
-            inner_consts = inner.consts if hasattr(inner, "consts") else ()
+        if name in _CUSTOM_CALL:
             invals = [_cast(v, var.aval.dtype) if _is_float(v) else v
-                      for v, var in zip(invals, inner_jaxpr.invars)]
-            outvals = _eval_jaxpr(inner_jaxpr, inner_consts, invals,
-                                  compute_dtype)
+                      for v, var in zip(invals, eqn.invars)]
+            subfuns, bind_params = eqn.primitive.get_bind_params(params)
+            outvals = eqn.primitive.bind(*subfuns, *invals, **bind_params)
         elif name in _RECURSE and "jaxpr" in eqn.params:
             inner = eqn.params["jaxpr"]
             inner_jaxpr = inner.jaxpr if hasattr(inner, "jaxpr") else inner

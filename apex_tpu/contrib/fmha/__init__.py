@@ -1,0 +1,84 @@
+"""FMHA — TPU rebuild of ``apex/contrib/fmha/fmha.py`` (MLPerf-BERT
+fused multi-head attention, ``fmha/src/*.cu``).
+
+The reference packs variable-length sequences into one token axis and
+dispatches per-seqlen CUDA templates (128/256/384/512).  Here the packed
+``cu_seqlens`` surface is kept, but the core is the Pallas flash-attention
+kernel, which has no sequence-length cap: the packed tokens are scattered
+to a dense ``(batch, max_s)`` layout, attended with per-row ``kv_seqlens``
+masking (identical semantics to the packed kernels — keys beyond a row's
+length contribute nothing), and gathered back to the packed layout.
+
+``fmha(qkv, cu_seqlens, max_s)`` with ``qkv`` of shape
+``(total_tokens, 3, heads, head_dim)`` mirrors ``FMHAFun.apply``.
+Probability dropout (``p_dropout > 0``) uses the materialized-probs
+reference path and needs a ``dropout_rng``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.flash_attention import flash_attention
+
+__all__ = ["fmha", "FMHAFun"]
+
+
+def _token_coords(cu_seqlens, total):
+    """Per-token (batch_row, offset) for packed layout; cu_seqlens is
+    ``(batch+1,)`` monotone int32 with cu_seqlens[-1] == total tokens."""
+    tok = jnp.arange(total)
+    row = jnp.searchsorted(cu_seqlens, tok, side="right") - 1
+    off = tok - cu_seqlens[row]
+    return row, off
+
+
+def fmha(qkv, cu_seqlens, max_s, p_dropout=0.0, is_training=True,
+         causal=False, dropout_rng=None):
+    """Packed fused MHA: ``qkv (total, 3, h, d)`` -> ``(total, h, d)``.
+
+    ``cu_seqlens``: ``(batch+1,)`` cumulative sequence starts (apex
+    convention); ``max_s``: static maximum sequence length (defines the
+    dense scratch layout, like the reference's seqlen template choice).
+    """
+    total, three, h, d = qkv.shape
+    if three != 3:
+        raise ValueError("qkv must be (total, 3, heads, head_dim)")
+    b = cu_seqlens.shape[0] - 1
+    lens = (cu_seqlens[1:] - cu_seqlens[:-1]).astype(jnp.int32)
+    row, off = _token_coords(cu_seqlens, total)
+
+    # scatter packed tokens into dense (b, max_s, 3, h, d); padded slots
+    # stay zero and are masked by kv_seqlens inside the kernel
+    dense = jnp.zeros((b, max_s) + qkv.shape[1:], qkv.dtype)
+    dense = dense.at[row, off].set(qkv)
+    q = dense[:, :, 0].transpose(0, 2, 1, 3)      # (b, h, s, d)
+    k = dense[:, :, 1].transpose(0, 2, 1, 3)
+    v = dense[:, :, 2].transpose(0, 2, 1, 3)
+
+    if p_dropout > 0.0 and is_training:
+        if dropout_rng is None:
+            raise ValueError("p_dropout > 0 needs dropout_rng")
+        ctx = flash_attention_reference(
+            q, k, v, causal=causal, kv_seqlens=lens, dropout=p_dropout,
+            dropout_rng=dropout_rng)
+    else:
+        ctx = flash_attention(q, k, v, causal=causal, kv_seqlens=lens)
+
+    # gather back to the packed token axis
+    ctx = ctx.transpose(0, 2, 1, 3)               # (b, s, h, d)
+    return ctx[row, off]
+
+
+class FMHAFun:
+    """Drop-in for the reference's autograd-function handle:
+    ``FMHAFun.apply(qkv, cu_seqlens, seqlens, p_dropout, max_s,
+    is_training)``."""
+
+    @staticmethod
+    def apply(qkv, cu_seqlens, seqlens, p_dropout, max_s,
+              is_training=True, dropout_rng=None):
+        del seqlens  # derivable from cu_seqlens (reference passes both)
+        return fmha(qkv, cu_seqlens, max_s, p_dropout, is_training,
+                    dropout_rng=dropout_rng)

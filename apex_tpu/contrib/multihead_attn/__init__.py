@@ -1,0 +1,187 @@
+"""Fused multi-head attention modules — TPU rebuild of
+``apex/contrib/multihead_attn/`` (``self_multihead_attn.py``,
+``encdec_multihead_attn.py`` + their ``*_func.py`` CUDA autograd
+functions).
+
+The CUDA path fuses strided-batched GEMMs + softmax + philox dropout into
+one autograd node; here the fused core is the Pallas flash-attention
+kernel (:mod:`apex_tpu.ops.flash_attention`) — memory O(s) instead of the
+reference's materialized probabilities.  Layout parity with apex/torch
+MHA: activations are ``(seq, batch, hidden)``.
+
+``include_norm_add=True`` mirrors apex's ``*_norm_add`` variants: the
+input is layer-normed before projection and the residual added to the
+output.  Attention-probability dropout needs the materialized-probs path
+(the flash kernel never forms probabilities); with ``dropout > 0`` and
+``is_training=True`` the module uses the jnp reference and requires a
+``dropout_rng`` key — pass ``is_training=False`` (or dropout 0) for the
+fused inference/eval path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.normalization import FusedLayerNorm
+from apex_tpu.ops.flash_attention import (
+    flash_attention,
+    flash_attention_reference,
+)
+
+__all__ = ["SelfMultiheadAttn", "EncdecMultiheadAttn"]
+
+_f32 = jnp.float32
+
+
+def _init_linear(key, out_features, in_features, bias, param_dtype):
+    # apex uses xavier_uniform_ on the packed projection weights
+    bound = (6.0 / (in_features + out_features)) ** 0.5
+    p = {"weight": jax.random.uniform(
+        key, (out_features, in_features), param_dtype, -bound, bound)}
+    if bias:
+        p["bias"] = jnp.zeros((out_features,), param_dtype)
+    return p
+
+
+def _linear(p, x):
+    y = x @ p["weight"].T.astype(x.dtype)
+    if "bias" in p:
+        y = y + p["bias"].astype(x.dtype)
+    return y
+
+
+def _attend(q, k, v, heads, causal, kv_seqlens, key_padding_mask,
+            dropout, dropout_rng):
+    """q/k/v: (s, b, hidden) -> (s, b, hidden) via flash attention."""
+    sq, b, hidden = q.shape
+    sk = k.shape[0]
+    d = hidden // heads
+    # (s, b, h*d) -> (b, h, s, d)
+    qh = q.reshape(sq, b, heads, d).transpose(1, 2, 0, 3)
+    kh = k.reshape(sk, b, heads, d).transpose(1, 2, 0, 3)
+    vh = v.reshape(sk, b, heads, d).transpose(1, 2, 0, 3)
+    if key_padding_mask is not None or dropout > 0.0:
+        # arbitrary masks / prob-dropout need materialized probabilities;
+        # the reference path owns that logic (incl. kv_seqlens + fully
+        # masked rows) so the two paths cannot drift
+        ctx = flash_attention_reference(
+            qh, kh, vh, causal=causal, kv_seqlens=kv_seqlens,
+            key_padding_mask=key_padding_mask, dropout=dropout,
+            dropout_rng=dropout_rng)
+    else:
+        ctx = flash_attention(qh, kh, vh, causal=causal,
+                              kv_seqlens=kv_seqlens)
+    return ctx.transpose(2, 0, 1, 3).reshape(sq, b, hidden)
+
+
+class SelfMultiheadAttn:
+    """apex ``SelfMultiheadAttn``: packed-QKV fused self attention.
+
+    ``m = SelfMultiheadAttn(1024, 16); params = m.init_params(key)``;
+    ``out = m(params, x)`` with ``x`` of shape ``(seq, batch, hidden)``.
+    """
+
+    def __init__(self, embed_dim, num_heads, dropout=0.0, bias=False,
+                 include_norm_add=False, impl="fast",
+                 param_dtype=jnp.float32):
+        if embed_dim % num_heads:
+            raise ValueError("embed_dim must be divisible by num_heads")
+        self.embed_dim = int(embed_dim)
+        self.num_heads = int(num_heads)
+        self.dropout = float(dropout)
+        self.bias = bool(bias)
+        self.include_norm_add = bool(include_norm_add)
+        self.impl = impl
+        self.param_dtype = param_dtype
+        if include_norm_add:
+            self.lyr_nrm = FusedLayerNorm(embed_dim,
+                                          param_dtype=param_dtype)
+
+    def init_params(self, key):
+        k1, k2 = jax.random.split(key)
+        p = {"in_proj": _init_linear(k1, 3 * self.embed_dim,
+                                     self.embed_dim, self.bias,
+                                     self.param_dtype),
+             "out_proj": _init_linear(k2, self.embed_dim, self.embed_dim,
+                                      self.bias, self.param_dtype)}
+        if self.include_norm_add:
+            p["lyr_nrm"] = self.lyr_nrm.init_params()
+        return p
+
+    def __call__(self, params, query, key_padding_mask=None,
+                 attn_mask=None, kv_seqlens=None, is_training=True,
+                 dropout_rng=None):
+        del attn_mask  # apex's fast path ignores it for self-attn too
+        x = query
+        if self.include_norm_add:
+            x = self.lyr_nrm(params["lyr_nrm"], x).astype(query.dtype)
+        qkv = _linear(params["in_proj"], x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        dropout = self.dropout if is_training else 0.0
+        if dropout > 0.0 and dropout_rng is None:
+            raise ValueError(
+                "dropout > 0 with is_training=True needs dropout_rng")
+        ctx = _attend(q, k, v, self.num_heads, False, kv_seqlens,
+                      key_padding_mask, dropout, dropout_rng)
+        out = _linear(params["out_proj"], ctx)
+        if self.include_norm_add:
+            out = out + query
+        return out
+
+    apply = __call__
+
+
+class EncdecMultiheadAttn:
+    """apex ``EncdecMultiheadAttn``: query from the decoder, packed KV
+    from the encoder memory."""
+
+    def __init__(self, embed_dim, num_heads, dropout=0.0, bias=False,
+                 include_norm_add=False, impl="fast",
+                 param_dtype=jnp.float32):
+        if embed_dim % num_heads:
+            raise ValueError("embed_dim must be divisible by num_heads")
+        self.embed_dim = int(embed_dim)
+        self.num_heads = int(num_heads)
+        self.dropout = float(dropout)
+        self.bias = bool(bias)
+        self.include_norm_add = bool(include_norm_add)
+        self.impl = impl
+        self.param_dtype = param_dtype
+        if include_norm_add:
+            self.lyr_nrm = FusedLayerNorm(embed_dim,
+                                          param_dtype=param_dtype)
+
+    def init_params(self, key):
+        k1, k2, k3 = jax.random.split(key, 3)
+        p = {"q_proj": _init_linear(k1, self.embed_dim, self.embed_dim,
+                                    self.bias, self.param_dtype),
+             "kv_proj": _init_linear(k2, 2 * self.embed_dim,
+                                     self.embed_dim, self.bias,
+                                     self.param_dtype),
+             "out_proj": _init_linear(k3, self.embed_dim, self.embed_dim,
+                                      self.bias, self.param_dtype)}
+        if self.include_norm_add:
+            p["lyr_nrm"] = self.lyr_nrm.init_params()
+        return p
+
+    def __call__(self, params, query, key, key_padding_mask=None,
+                 kv_seqlens=None, is_training=True, dropout_rng=None):
+        x = query
+        if self.include_norm_add:
+            x = self.lyr_nrm(params["lyr_nrm"], x).astype(query.dtype)
+        q = _linear(params["q_proj"], x)
+        kv = _linear(params["kv_proj"], key)
+        k, v = jnp.split(kv, 2, axis=-1)
+        dropout = self.dropout if is_training else 0.0
+        if dropout > 0.0 and dropout_rng is None:
+            raise ValueError(
+                "dropout > 0 with is_training=True needs dropout_rng")
+        ctx = _attend(q, k, v, self.num_heads, False, kv_seqlens,
+                      key_padding_mask, dropout, dropout_rng)
+        out = _linear(params["out_proj"], ctx)
+        if self.include_norm_add:
+            out = out + query
+        return out
+
+    apply = __call__

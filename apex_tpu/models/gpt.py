@@ -5,7 +5,7 @@ same wiring here is the production model).
 
 Every compute block is a framework component: VocabParallelEmbedding,
 ColumnParallelLinear/RowParallelLinear (TP + sequence parallel),
-MixedFusedLayerNorm (Pallas), fused RoPE, FusedScaleMaskSoftmax (causal),
+MixedFusedLayerNorm (Pallas), fused RoPE, causal flash attention (Pallas),
 vocab-parallel cross entropy.  One config serves three execution modes:
 
 * serial  — ``tensor_parallel_size=1, axis_name=None`` (tests, single chip)
@@ -25,8 +25,8 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.normalization import MixedFusedLayerNorm
+from apex_tpu.ops.flash_attention import flash_attention
 from apex_tpu.ops.rope import fused_apply_rotary_pos_emb_cached, rope_freqs
-from apex_tpu.ops.softmax import scaled_upper_triang_masked_softmax
 from apex_tpu.transformer import tensor_parallel as tp
 
 _f32 = jnp.float32
@@ -44,6 +44,7 @@ class GPTConfig:
     axis_name: Optional[str] = None            # "model" inside shard_map
     sequence_parallel: bool = False
     rotary: bool = True
+    remat: bool = False                        # jax.checkpoint each layer
     dtype: jnp.dtype = jnp.float32             # activation/compute dtype
     param_dtype: jnp.dtype = jnp.float32
 
@@ -105,17 +106,12 @@ class ParallelAttention:
             k = fused_apply_rotary_pos_emb_cached(
                 k.transpose(1, 0, 2, 3), rope_cos, rope_sin
             ).transpose(1, 0, 2, 3)
-        # (b, nh, s, hd)
+        # (b, nh, s, hd) — blockwise flash attention: O(s) memory, no
+        # materialized (b*h, s, s) scores (the round-2 HBM ceiling)
         q = q.transpose(0, 2, 1, 3)
         k = k.transpose(0, 2, 1, 3)
         v = v.transpose(0, 2, 1, 3)
-        scale = 1.0 / float(cfg.head_dim) ** 0.5
-        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                            preferred_element_type=_f32)
-        probs = scaled_upper_triang_masked_softmax(
-            scores.reshape(b * nh, s, s), scale)
-        probs = probs.reshape(b, nh, s, s).astype(v.dtype)
-        ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+        ctx = flash_attention(q, k, v, causal=True)
         ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, nh * cfg.head_dim)
         out, _ = self.proj(params["proj"], ctx)
         return out
@@ -218,7 +214,14 @@ class GPTModel:
     def backbone(self, params, x, seq_len=None):
         cos, sin = self.rope_tables(seq_len or x.shape[1])
         for layer, lp in zip(self.layers, params["layers"]):
-            x = layer(lp, x, cos, sin)
+            if self.cfg.remat:
+                # trade recompute for activation memory (apex
+                # tensor_parallel.checkpoint → jax.checkpoint)
+                x = jax.checkpoint(
+                    lambda lp, x, c, s, _l=layer: _l(lp, x, c, s))(
+                        lp, x, cos, sin)
+            else:
+                x = layer(lp, x, cos, sin)
         return x
 
     def logits(self, params, x):

@@ -39,7 +39,9 @@ def _meta_for(tensors: Sequence[jax.Array], dtype=None,
 
 @functools.lru_cache(maxsize=512)
 def _row_ids_cached(meta: B.BucketMeta):
-    return jnp.asarray(B.row_tensor_ids(meta))
+    # host constant, NOT a jnp array: a device array created inside a
+    # trace (e.g. first call under shard_map) would cache a tracer
+    return B.row_tensor_ids(meta)
 
 
 def _per_tensor_from_rowsq(rowsq: jax.Array, meta: B.BucketMeta) -> jax.Array:

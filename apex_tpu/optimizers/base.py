@@ -62,6 +62,12 @@ class FusedOptimizer:
 
     # -- layout ------------------------------------------------------------
 
+    def _meta_block_rows(self) -> int:
+        """Row multiple for bucket padding.  Distributed (ZeRO) subclasses
+        align to ``block_rows * world_size`` so every per-device shard is a
+        whole number of kernel blocks."""
+        return self.block_rows
+
     def _layout(self, params) -> Layout:
         leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(
             params)
@@ -79,7 +85,7 @@ class FusedOptimizer:
         buckets = []
         for (name, dtype), idxs in groups.items():
             shapes = tuple(tuple(leaves[i].shape) for i in idxs)
-            meta = B.bucket_meta(shapes, dtype, self.block_rows)
+            meta = B.bucket_meta(shapes, dtype, self._meta_block_rows())
             buckets.append(BucketInfo(f"{name}/{dtype}", name,
                                       tuple(idxs), meta))
         layout = Layout(tuple(buckets), len(leaves))

@@ -1,18 +1,26 @@
 """apex_tpu benchmark — run on the real TPU chip, print ONE JSON line.
 
-Measures the two binding BASELINE.md metrics that are measurable on a
-single chip:
+Measures the binding BASELINE.md metrics that are measurable on a single
+chip:
 
-* GPT (350M-class) fwd+bwd+FusedAdam step -> tokens/s and MFU vs the
-  chip's peak bf16 FLOPs (north star: >=50% MFU at pod scale).
+* GPT (350M-class) fwd+bwd+FusedAdam step -> tokens/s and MFU (north
+  star: >=50% MFU at pod scale).  Attention is the Pallas flash kernel,
+  so batch is no longer HBM-capped by materialized scores.
 * FusedAdam packed-bucket step vs unfused optax adam on the same params
   -> speedup (the core premise of the multi-tensor engine).
 
-The headline metric is MFU; everything else rides in "extra".
+MFU accounting: the denominator is calibrated IN-BENCH — a large bf16
+matmul is timed on the same device and the peak is
+``max(sustained_matmul, spec_sheet)`` — because the tunneled device's
+`device_kind` string has proven unreliable as a spec lookup (round 2
+reported a "fraction" of 16.9).  Both spec and calibrated MFU are
+reported; the headline is the calibrated one and is asserted to lie in
+(0, 1].
 """
 
 from __future__ import annotations
 
+import functools
 import json
 import time
 
@@ -24,20 +32,45 @@ import numpy as np
 _PEAK_BF16 = {
     "TPU v5 lite": 197e12,       # v5e
     "TPU v5e": 197e12,
-    "TPU v5": 459e12,            # v5p
     "TPU v5p": 459e12,
+    "TPU v5": 459e12,
     "TPU v4": 275e12,
     "TPU v6 lite": 918e12,       # v6e / Trillium
     "TPU v6e": 918e12,
 }
 
 
-def _peak_flops() -> float:
+def _spec_peak() -> float:
     kind = jax.devices()[0].device_kind
+    # longest matching prefix wins ("TPU v5 lite" before "TPU v5")
+    best = 0.0
+    best_len = -1
     for k, v in _PEAK_BF16.items():
-        if kind.startswith(k):
-            return v
-    return 197e12  # conservative default
+        if kind.startswith(k) and len(k) > best_len:
+            best, best_len = v, len(k)
+    return best if best_len >= 0 else 197e12  # conservative default
+
+
+def _calibrated_peak() -> float:
+    """Sustained bf16 matmul FLOP/s on this device (8192^3, steady state)."""
+    n = 8192
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, (n, n), jnp.bfloat16)
+    b = jax.random.normal(key, (n, n), jnp.bfloat16)
+
+    @jax.jit
+    def mm(a, b):
+        return jnp.dot(a, b, preferred_element_type=jnp.bfloat16)
+
+    out = mm(a, b)
+    jax.block_until_ready(out)
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = mm(a, b)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    return 2.0 * n ** 3 / dt
 
 
 def _time_steps(fn, args, warmup=2, iters=8):
@@ -56,11 +89,9 @@ def bench_gpt_train_step():
     from apex_tpu.optimizers import FusedAdam
 
     cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
-                    num_attention_heads=16, max_seq_len=1024,
+                    num_attention_heads=16, max_seq_len=1024, remat=True,
                     dtype=jnp.bfloat16)
-    # batch is HBM-bound until flash attention lands: the materialized
-    # (b*h, s, s) scores+probs dominate at ~1.5 GB/batch-row for 24 layers
-    batch, seq = 2, 1024
+    batch, seq = 16, 1024
     model = GPTModel(cfg)
     params = model.init_params(jax.random.PRNGKey(0))
     n_params = sum(int(np.prod(p.shape))
@@ -71,27 +102,43 @@ def bench_gpt_train_step():
     tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
     targets = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
 
-    @jax.jit
+    # donation (params + opt state reuse their buffers) and per-layer
+    # remat keep the 350M config inside a single chip's HBM at batch 16
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def train_step(params, opt_state, tokens, targets):
         loss, grads = jax.value_and_grad(model.loss)(params, tokens,
                                                      targets)
         new_params, new_opt = adam.step(grads, params, opt_state)
         return loss, new_params, new_opt
 
-    # steady-state timing with state threading (donation-free but honest)
-    def run(params, opt_state, tokens, targets):
-        return train_step(params, opt_state, tokens, targets)
+    def run(tokens, targets):
+        nonlocal params, opt_state
+        loss, params, opt_state = train_step(params, opt_state, tokens,
+                                             targets)
+        return loss
 
-    dt = _time_steps(run, (params, opt_state, tokens, targets))
+    dt = _time_steps(run, (tokens, targets))
     tokens_per_s = batch * seq / dt
     # PaLM-style accounting: 6*N per token (fwd+bwd) + attention term
     flops_per_token = 6 * n_params + 12 * cfg.num_layers * cfg.hidden_size \
         * seq
-    mfu = tokens_per_s * flops_per_token / _peak_flops()
+    achieved = tokens_per_s * flops_per_token
+    spec = _spec_peak()
+    calibrated = max(_calibrated_peak(), spec)
+    mfu_spec = achieved / spec
+    mfu = achieved / calibrated
+    assert 0.0 < mfu <= 1.0, (
+        f"calibrated MFU {mfu} outside (0, 1] — bad peak accounting")
     return {
         "n_params": n_params,
+        "batch": batch,
+        "seq": seq,
         "step_time_s": dt,
         "tokens_per_s": tokens_per_s,
+        "achieved_flops": achieved,
+        "peak_spec": spec,
+        "peak_calibrated": calibrated,
+        "mfu_spec": mfu_spec,
         "mfu": mfu,
     }
 

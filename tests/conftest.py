@@ -1,29 +1,54 @@
-"""Test configuration: run everything on a fake 8-device CPU mesh.
+"""Test configuration: two lanes.
 
-Apex's distributed tests spawn one process per GPU
-(``apex/transformer/testing/distributed_test_base.py``) and skip without
-hardware.  The TPU rebuild does better: XLA can emulate N devices on CPU, so
-every TP/PP/DP test runs hardware-free in one process.  These env vars must
-be set before JAX initializes, hence at conftest import time.
+* Default lane — everything on a fake 8-device CPU mesh.  Apex's
+  distributed tests spawn one process per GPU
+  (``apex/transformer/testing/distributed_test_base.py``) and skip without
+  hardware; XLA can emulate N devices on CPU, so every TP/PP/DP test runs
+  hardware-free in one process.  These env vars must be set before JAX
+  initializes, hence at conftest import time.
+* On-chip lane — ``APEX_TPU_ON_CHIP=1 pytest -m tpu`` leaves the real TPU
+  backend in place and runs the hardware-marked tests (Pallas kernel
+  parity, amp x Pallas composition, train-step smoke) where the kernels
+  actually run.  The reference runs every kernel test on real hardware;
+  this is the equivalent gate (CPU interpret mode does not enforce TPU
+  tiling/VMEM limits).
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"  # override axon/TPU: tests are CPU-only
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+ON_CHIP = os.environ.get("APEX_TPU_ON_CHIP") == "1"
+
+if not ON_CHIP:
+    os.environ["JAX_PLATFORMS"] = "cpu"  # override axon/TPU
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
 
-# The axon TPU plugin force-registers itself (jax_platforms becomes
-# "axon,cpu" regardless of the env var) — override after import.
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_enable_x64", False)
-assert jax.default_backend() == "cpu"
+if not ON_CHIP:
+    # The axon TPU plugin force-registers itself (jax_platforms becomes
+    # "axon,cpu" regardless of the env var) — override after import.
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", False)
+    assert jax.default_backend() == "cpu"
 
 import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "tpu: requires the real TPU chip "
+                   "(run via APEX_TPU_ON_CHIP=1 pytest -m tpu)")
+
+
+def pytest_collection_modifyitems(config, items):
+    skip_tpu = pytest.mark.skip(
+        reason="on-chip lane only (APEX_TPU_ON_CHIP=1 pytest -m tpu)")
+    for item in items:
+        if "tpu" in item.keywords and not ON_CHIP:
+            item.add_marker(skip_tpu)
 
 
 @pytest.fixture

@@ -1,0 +1,201 @@
+"""contrib.multihead_attn / contrib.fmha vs unfused references
+(pattern: ``apex/contrib/test/multihead_attn/``, ``test/fmha/``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.contrib.fmha import FMHAFun, fmha
+from apex_tpu.contrib.multihead_attn import (
+    EncdecMultiheadAttn,
+    SelfMultiheadAttn,
+)
+from apex_tpu.utils import set_force_pallas
+
+
+@pytest.fixture(autouse=True)
+def _force_pallas():
+    set_force_pallas(True)
+    yield
+    set_force_pallas(None)
+
+
+def _ref_mha(q, k, v, heads, causal=False, pad_mask=None):
+    """(s, b, hidden) torch-style reference."""
+    sq, b, hidden = q.shape
+    sk = k.shape[0]
+    d = hidden // heads
+    qh = q.reshape(sq, b, heads, d).transpose(1, 2, 0, 3)
+    kh = k.reshape(sk, b, heads, d).transpose(1, 2, 0, 3)
+    vh = v.reshape(sk, b, heads, d).transpose(1, 2, 0, 3)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * d ** -0.5
+    if pad_mask is not None:
+        s = jnp.where(pad_mask[:, None, None, :], -1e30, s)
+    if causal:
+        s = jnp.where(jnp.arange(sk)[None, None, None, :]
+                      > jnp.arange(sq)[None, None, :, None], -1e30, s)
+    p = jax.nn.softmax(s, axis=-1)
+    ctx = jnp.einsum("bhqk,bhkd->bhqd", p, vh)
+    return ctx.transpose(2, 0, 1, 3).reshape(sq, b, hidden)
+
+
+def _lin(p, x):
+    y = x @ p["weight"].T
+    if "bias" in p:
+        y = y + p["bias"]
+    return y
+
+
+class TestSelfMultiheadAttn:
+    def test_matches_reference(self, rng):
+        m = SelfMultiheadAttn(64, 4, bias=True)
+        params = m.init_params(jax.random.PRNGKey(0))
+        x = jnp.asarray(rng.randn(16, 2, 64), jnp.float32)
+        out = m(params, x)
+        qkv = _lin(params["in_proj"], x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        ref = _lin(params["out_proj"], _ref_mha(q, k, v, 4))
+        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+    def test_norm_add(self, rng):
+        m = SelfMultiheadAttn(64, 4, include_norm_add=True)
+        params = m.init_params(jax.random.PRNGKey(1))
+        x = jnp.asarray(rng.randn(8, 2, 64), jnp.float32)
+        out = m(params, x)
+        # residual add must be the RAW input (apex norm_add semantics)
+        xn = (x - x.mean(-1, keepdims=True)) / jnp.sqrt(
+            x.var(-1, keepdims=True) + 1e-5)
+        xn = xn * params["lyr_nrm"]["weight"] + params["lyr_nrm"]["bias"]
+        qkv = _lin(params["in_proj"], xn)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        ref = _lin(params["out_proj"], _ref_mha(q, k, v, 4)) + x
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+    def test_key_padding_mask(self, rng):
+        m = SelfMultiheadAttn(32, 2)
+        params = m.init_params(jax.random.PRNGKey(2))
+        x = jnp.asarray(rng.randn(8, 3, 32), jnp.float32)
+        mask = jnp.asarray(rng.rand(3, 8) > 0.7)
+        out = m(params, x, key_padding_mask=mask)
+        qkv = _lin(params["in_proj"], x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        ref = _lin(params["out_proj"],
+                   _ref_mha(q, k, v, 2, pad_mask=mask))
+        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+    def test_grad_flows(self, rng):
+        m = SelfMultiheadAttn(32, 2, bias=True)
+        params = m.init_params(jax.random.PRNGKey(3))
+        x = jnp.asarray(rng.randn(8, 2, 32), jnp.float32)
+        g = jax.grad(lambda p: jnp.sum(m(p, x) ** 2))(params)
+        for leaf in jax.tree_util.tree_leaves(g):
+            assert np.all(np.isfinite(leaf))
+            assert float(jnp.abs(leaf).max()) > 0
+
+    def test_dropout_requires_rng(self, rng):
+        m = SelfMultiheadAttn(32, 2, dropout=0.5)
+        params = m.init_params(jax.random.PRNGKey(4))
+        x = jnp.asarray(rng.randn(4, 1, 32), jnp.float32)
+        with pytest.raises(ValueError):
+            m(params, x)
+        out = m(params, x, dropout_rng=jax.random.PRNGKey(5))
+        assert out.shape == x.shape
+        # eval mode: dropout off, deterministic
+        o1 = m(params, x, is_training=False)
+        o2 = m(params, x, is_training=False)
+        np.testing.assert_array_equal(o1, o2)
+
+
+class TestMaterializedPathSemantics:
+    """The materialized (mask/dropout) path must keep the SAME masking
+    semantics as the fused path — review findings from round 3."""
+
+    def test_kv_seqlens_respected_with_padding_mask(self, rng):
+        # both kv_seqlens and key_padding_mask present → the materialized
+        # path must apply BOTH (kv_seqlens used to be dropped)
+        m = SelfMultiheadAttn(32, 2)
+        params = m.init_params(jax.random.PRNGKey(0))
+        x = jnp.asarray(rng.randn(8, 2, 32), jnp.float32)
+        lens = jnp.asarray([5, 8], jnp.int32)
+        mask = jnp.zeros((2, 8), bool).at[0, 1].set(True)
+        out = m(params, x, key_padding_mask=mask, kv_seqlens=lens)
+        # equivalent single mask: padded OR explicitly masked
+        combined = mask | (jnp.arange(8)[None, :] >= lens[:, None])
+        ref = m(params, x, key_padding_mask=combined)
+        np.testing.assert_allclose(out, ref, rtol=1e-6, atol=1e-6)
+
+    def test_fully_masked_row_outputs_zero(self, rng):
+        m = SelfMultiheadAttn(32, 2)
+        params = m.init_params(jax.random.PRNGKey(1))
+        x = jnp.asarray(rng.randn(4, 2, 32), jnp.float32)
+        mask = jnp.zeros((2, 4), bool).at[1].set(True)  # row 1 all masked
+        out = m(params, x, key_padding_mask=mask)
+        # fully masked row: attention context is exactly 0, so the output
+        # is only the out_proj bias (bias=False here → 0)
+        np.testing.assert_allclose(np.asarray(out[:, 1]), 0.0, atol=1e-6)
+
+
+class TestEncdecMultiheadAttn:
+    def test_matches_reference(self, rng):
+        m = EncdecMultiheadAttn(64, 4, bias=True)
+        params = m.init_params(jax.random.PRNGKey(0))
+        q_in = jnp.asarray(rng.randn(8, 2, 64), jnp.float32)
+        mem = jnp.asarray(rng.randn(16, 2, 64), jnp.float32)
+        out = m(params, q_in, mem)
+        q = _lin(params["q_proj"], q_in)
+        kv = _lin(params["kv_proj"], mem)
+        k, v = jnp.split(kv, 2, axis=-1)
+        ref = _lin(params["out_proj"], _ref_mha(q, k, v, 4))
+        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+
+class TestFMHA:
+    def test_packed_matches_per_sequence(self, rng):
+        h, d = 2, 32
+        lens = [5, 12, 8]
+        total = sum(lens)
+        cu = jnp.asarray(np.cumsum([0] + lens), jnp.int32)
+        qkv = jnp.asarray(rng.randn(total, 3, h, d), jnp.float32)
+        out = fmha(qkv, cu, max_s=16)
+        # reference: attend each sequence independently at full density
+        for i, L in enumerate(lens):
+            seg = qkv[int(cu[i]):int(cu[i + 1])]      # (L, 3, h, d)
+            q = seg[:, 0].transpose(1, 0, 2)          # (h, L, d)
+            k = seg[:, 1].transpose(1, 0, 2)
+            v = seg[:, 2].transpose(1, 0, 2)
+            s = jnp.einsum("hqd,hkd->hqk", q, k) * d ** -0.5
+            p = jax.nn.softmax(s, axis=-1)
+            ref = jnp.einsum("hqk,hkd->hqd", p, v).transpose(1, 0, 2)
+            np.testing.assert_allclose(out[int(cu[i]):int(cu[i + 1])],
+                                       ref, rtol=2e-5, atol=2e-5)
+
+    def test_causal(self, rng):
+        h, d = 2, 32
+        lens = [10, 6]
+        cu = jnp.asarray(np.cumsum([0] + lens), jnp.int32)
+        qkv = jnp.asarray(rng.randn(sum(lens), 3, h, d), jnp.float32)
+        out = fmha(qkv, cu, max_s=16, causal=True)
+        for i, L in enumerate(lens):
+            seg = qkv[int(cu[i]):int(cu[i + 1])]
+            q = seg[:, 0].transpose(1, 0, 2)
+            k = seg[:, 1].transpose(1, 0, 2)
+            v = seg[:, 2].transpose(1, 0, 2)
+            s = jnp.einsum("hqd,hkd->hqk", q, k) * d ** -0.5
+            s = jnp.where(jnp.arange(L)[None, None, :]
+                          > jnp.arange(L)[None, :, None], -1e30, s)
+            p = jax.nn.softmax(s, axis=-1)
+            ref = jnp.einsum("hqk,hkd->hqd", p, v).transpose(1, 0, 2)
+            np.testing.assert_allclose(out[int(cu[i]):int(cu[i + 1])],
+                                       ref, rtol=2e-5, atol=2e-5)
+
+    def test_apply_wrapper_and_grad(self, rng):
+        lens = [7, 9]
+        cu = jnp.asarray(np.cumsum([0] + lens), jnp.int32)
+        qkv = jnp.asarray(rng.randn(sum(lens), 3, 2, 32), jnp.float32)
+        out = FMHAFun.apply(qkv, cu, None, 0.0, 16)
+        assert out.shape == (sum(lens), 2, 32)
+        g = jax.grad(lambda x: jnp.sum(
+            fmha(x, cu, max_s=16) ** 2))(qkv)
+        assert np.all(np.isfinite(g))
+        assert float(jnp.abs(g).max()) > 0
