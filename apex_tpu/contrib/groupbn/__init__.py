@@ -1,0 +1,83 @@
+"""NHWC GroupBatchNorm — TPU rebuild of ``apex/contrib/groupbn/``
+(``batch_norm.py`` + ``csrc/groupbn/batch_norm.cu``, the MLPerf-ResNet
+fused BN kernels).
+
+The reference fuses NHWC batch norm with the optional residual add and
+ReLU (``BatchNorm2d_NHWC(fuse_relu=True)``, ``bn_addrelu``); its "group"
+machinery spreads the stats reduction over a GPU group via CUDA IPC.  On
+TPU: channels-last is native, the normalize+add+relu chain is one XLA
+fusion, and the cross-device stats reduction is a ``psum`` over a mesh
+axis (pass ``axis_name`` inside ``shard_map``) — the same design as
+:mod:`apex_tpu.parallel.sync_batchnorm` but with the contrib surface.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["BatchNorm2d_NHWC"]
+
+_f32 = jnp.float32
+
+
+class BatchNorm2d_NHWC:
+    """``(N, H, W, C)`` batch norm with optional fused residual-add and
+    ReLU.  Functional state: ``params/state = m.init()``;
+    ``y, new_state = m(params, state, x, z=None, training=True)``."""
+
+    def __init__(self, num_features, eps=1e-5, momentum=0.9,
+                 fuse_relu=False, bn_group=1, axis_name=None,
+                 param_dtype=jnp.float32):
+        self.num_features = int(num_features)
+        self.eps = float(eps)
+        self.momentum = float(momentum)
+        self.fuse_relu = bool(fuse_relu)
+        # bn_group>1 in the reference = stats over a device group; here
+        # any axis_name means "reduce stats over that mesh axis"
+        self.axis_name = axis_name if (axis_name or bn_group > 1) else None
+        self.param_dtype = param_dtype
+
+    def init_params(self):
+        c = self.num_features
+        return {"weight": jnp.ones((c,), self.param_dtype),
+                "bias": jnp.zeros((c,), self.param_dtype)}
+
+    def init_state(self):
+        c = self.num_features
+        return {"running_mean": jnp.zeros((c,), _f32),
+                "running_var": jnp.ones((c,), _f32)}
+
+    def __call__(self, params, state, x, z=None, training=True):
+        xf = x.astype(_f32)
+        if training:
+            n = jnp.asarray(x.size // x.shape[-1], _f32)
+            s = jnp.sum(xf, axis=(0, 1, 2))
+            sq = jnp.sum(xf * xf, axis=(0, 1, 2))
+            if self.axis_name is not None:
+                s = jax.lax.psum(s, self.axis_name)
+                sq = jax.lax.psum(sq, self.axis_name)
+                n = jax.lax.psum(n, self.axis_name)
+            mean = s / n
+            var = sq / n - mean * mean
+            unbiased = var * n / jnp.maximum(n - 1.0, 1.0)
+            m = self.momentum
+            new_state = {
+                "running_mean": m * state["running_mean"]
+                + (1 - m) * mean,
+                "running_var": m * state["running_var"]
+                + (1 - m) * unbiased,
+            }
+        else:
+            mean, var = state["running_mean"], state["running_var"]
+            new_state = state
+        y = (xf - mean) * jax.lax.rsqrt(var + self.eps)
+        y = y * params["weight"].astype(_f32) \
+            + params["bias"].astype(_f32)
+        if z is not None:                 # fused bn_addrelu residual
+            y = y + z.astype(_f32)
+        if self.fuse_relu or z is not None:
+            y = jnp.maximum(y, 0.0)
+        return y.astype(x.dtype), new_state
+
+    apply = __call__

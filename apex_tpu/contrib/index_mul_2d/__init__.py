@@ -1,0 +1,24 @@
+"""index_mul_2d — TPU rebuild of ``apex/contrib/index_mul_2d/``
+(``index_mul_2d.py`` + ``csrc/index_mul_2d/index_mul_2d_cuda.cu``).
+
+The reference fuses the gather and the elementwise product
+``out = in1[idx] * in2`` (used by OpenFold) into one kernel with a
+matching fused backward (scatter-add for ``d_in1``).  XLA emits exactly
+that from the jnp expression (gather + multiply fuse; the transpose of
+gather is scatter-add), so the op is the expression itself — kept as a
+named function for surface parity and testability.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["index_mul_2d"]
+
+
+def index_mul_2d(in1, in2, idx):
+    """``in1[idx] * in2`` where ``in1`` is ``(N, D)``, ``idx`` ``(M,)``
+    int rows, ``in2`` ``(M, D)``; returns ``(M, D)``."""
+    if in1.ndim != 2 or in2.ndim != 2:
+        raise ValueError("index_mul_2d operates on 2-D operands")
+    return jnp.take(in1, idx, axis=0) * in2

@@ -1,0 +1,31 @@
+"""FastLayerNorm surface — TPU rebuild of ``apex/contrib/layer_norm/``
+(``layer_norm.py`` over ``csrc/layer_norm/ln_api.cpp`` + the persistent
+per-hidden-size kernels).
+
+The reference ships one hand-tuned kernel per supported hidden size
+(768…65536); the TPU equivalent is the single Pallas LayerNorm in
+:mod:`apex_tpu.ops.layer_norm` whose block shape adapts to the hidden
+size, so ``FastLayerNorm`` is the module surface over that kernel with
+the reference's constructor (and no hidden-size whitelist).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from apex_tpu.normalization import FusedLayerNorm
+from apex_tpu.ops.layer_norm import fused_layer_norm_affine
+
+__all__ = ["FastLayerNorm", "fast_layer_norm"]
+
+
+def fast_layer_norm(x, weight, bias, epsilon=1e-5):
+    return fused_layer_norm_affine(x, weight, bias, eps=epsilon)
+
+
+class FastLayerNorm(FusedLayerNorm):
+    """apex ``contrib.layer_norm.FastLayerNorm(hidden_size, eps)``."""
+
+    def __init__(self, hidden_size, eps=1e-5, param_dtype=jnp.float32):
+        super().__init__(hidden_size, eps=eps, elementwise_affine=True,
+                         param_dtype=param_dtype)

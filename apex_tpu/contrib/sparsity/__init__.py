@@ -1,0 +1,86 @@
+"""ASP (automatic structured sparsity) — TPU rebuild of
+``apex/contrib/sparsity/`` (``asp.py``, ``sparse_masklib.py``; the CUDA
+permutation-search kernels are an accuracy refinement, not ported).
+
+The reference finds 2:4 magnitude masks for prunable weights, masks
+them, and re-applies the masks after every optimizer step (the optimizer
+step hook).  Functional JAX has no in-place hooks, so the surface is
+explicit: ``compute_sparse_masks`` builds the mask pytree,
+``apply_masks`` multiplies, and ``wrap_optimizer_step`` returns a step
+function that re-masks after the update — same training loop shape as
+``ASP.init_optimizer_for_pruning``.
+
+2:4 on TPU note: XLA has no sparse-MXU path today, so the win ASP
+preserves is model-compression/accuracy parity, not step time; the mask
+semantics (per 4 consecutive weights along the input dim, keep the top
+2 magnitudes) match ``sparse_masklib.create_mask(pattern="m4n2_1d")``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["create_mask", "ASP"]
+
+
+def create_mask(tensor, pattern="m4n2_1d"):
+    """Boolean keep-mask with the reference's ``m4n2_1d`` pattern: in
+    every 4 consecutive elements of the last axis, keep the 2 largest
+    magnitudes."""
+    if pattern != "m4n2_1d":
+        raise ValueError(f"unsupported pattern {pattern!r}")
+    if tensor.shape[-1] % 4:
+        raise ValueError("last dim must be divisible by 4 for m4n2")
+    mag = jnp.abs(tensor).reshape(tensor.shape[:-1] + (-1, 4))
+    # rank within each group of 4; keep the top 2
+    order = jnp.argsort(mag, axis=-1)            # ascending
+    ranks = jnp.argsort(order, axis=-1)
+    keep = ranks >= 2
+    return keep.reshape(tensor.shape)
+
+
+def _default_prunable(path, leaf):
+    """apex default: prune 2-D+ weights with input dim divisible by 4
+    and both dims >= 32 (skips tiny/vector params and embeddings are the
+    caller's policy via ``is_prunable``)."""
+    return (leaf.ndim >= 2 and leaf.shape[-1] % 4 == 0
+            and leaf.shape[-1] >= 32 and leaf.shape[-2] >= 32)
+
+
+class ASP:
+    """apex ``ASP`` adapted to functional params.
+
+    ``asp = ASP(); masks = asp.compute_sparse_masks(params)``;
+    ``params = asp.apply_masks(params, masks)``;
+    ``step = asp.wrap_optimizer_step(opt.step, masks)``.
+    """
+
+    def __init__(self, mask_calculator="m4n2_1d", is_prunable=None):
+        self.pattern = mask_calculator
+        self.is_prunable = is_prunable or _default_prunable
+
+    def compute_sparse_masks(self, params):
+        def mask_leaf(path, leaf):
+            p = jax.tree_util.keystr(path)
+            if self.is_prunable(p, leaf):
+                return create_mask(leaf, self.pattern)
+            return jnp.ones(leaf.shape, bool)
+
+        return jax.tree_util.tree_map_with_path(mask_leaf, params)
+
+    @staticmethod
+    def apply_masks(params, masks):
+        return jax.tree_util.tree_map(
+            lambda p, m: jnp.where(m, p, jnp.zeros((), p.dtype)), params,
+            masks)
+
+    def wrap_optimizer_step(self, step_fn, masks):
+        """Re-apply masks after every update (the reference's optimizer
+        hook): ``wrapped(grads, params, state, **kw)``."""
+
+        def wrapped(grads, params, state, **kw):
+            new_params, new_state = step_fn(grads, params, state, **kw)
+            return self.apply_masks(new_params, masks), new_state
+
+        return wrapped

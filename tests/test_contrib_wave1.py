@@ -1,0 +1,311 @@
+"""contrib wave 1 (focal_loss, index_mul_2d, group_norm, transducer,
+sparsity, layer_norm surface) vs unfused/numpy references — the apex
+``contrib/test/<pkg>`` pattern."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.contrib.focal_loss import FocalLoss, focal_loss
+from apex_tpu.contrib.group_norm import GroupNorm, group_norm_nhwc
+from apex_tpu.contrib.index_mul_2d import index_mul_2d
+from apex_tpu.contrib.layer_norm import FastLayerNorm
+from apex_tpu.contrib.sparsity import ASP, create_mask
+from apex_tpu.contrib.transducer import (
+    TransducerJoint,
+    TransducerLoss,
+    transducer_joint,
+    transducer_loss,
+)
+
+
+class TestFocalLoss:
+    def test_matches_manual_reference(self, rng):
+        logits = jnp.asarray(rng.randn(6, 5).astype(np.float32))
+        targets = jnp.asarray([0, 2, -1, 4, -1, 1])
+        alpha, gamma = 0.25, 2.0
+        out = focal_loss(logits, targets, num_positives_sum=4.0,
+                         alpha=alpha, gamma=gamma)
+        # manual per-element sigmoid focal loss
+        onehot = np.zeros((6, 5), np.float32)
+        for i, t in enumerate([0, 2, -1, 4, -1, 1]):
+            if t >= 0:
+                onehot[i, t] = 1.0
+        x = np.asarray(logits)
+        p = 1.0 / (1.0 + np.exp(-x))
+        bce = np.maximum(x, 0) - x * onehot + np.log1p(np.exp(-np.abs(x)))
+        p_t = p * onehot + (1 - p) * (1 - onehot)
+        a_t = alpha * onehot + (1 - alpha) * (1 - onehot)
+        ref = (a_t * (1 - p_t) ** gamma * bce).sum() / 4.0
+        np.testing.assert_allclose(float(out), ref, rtol=1e-5)
+
+    def test_ignore_and_padded_classes(self, rng):
+        logits = jnp.asarray(rng.randn(4, 8).astype(np.float32))
+        targets = jnp.asarray([1, -2, 3, -2])
+        full = focal_loss(logits, targets, 2.0, num_real_classes=6)
+        # ignored rows contribute nothing: zeroing them changes nothing
+        logits2 = logits.at[1].set(100.0).at[3].set(-100.0)
+        again = focal_loss(logits2, targets, 2.0, num_real_classes=6)
+        np.testing.assert_allclose(float(full), float(again), rtol=1e-6)
+
+    def test_apply_wrapper_and_grad(self, rng):
+        logits = jnp.asarray(rng.randn(4, 5).astype(np.float32))
+        targets = jnp.asarray([0, 1, 2, -1])
+        v = FocalLoss.apply(logits, targets, 3.0, 5, 0.25, 2.0)
+        g = jax.grad(lambda x: focal_loss(x, targets, 3.0))(logits)
+        assert np.isfinite(float(v))
+        assert np.all(np.isfinite(g))
+
+
+class TestIndexMul2d:
+    def test_matches_reference(self, rng):
+        in1 = jnp.asarray(rng.randn(10, 7).astype(np.float32))
+        in2 = jnp.asarray(rng.randn(4, 7).astype(np.float32))
+        idx = jnp.asarray([3, 0, 9, 3])
+        out = index_mul_2d(in1, in2, idx)
+        np.testing.assert_allclose(out, np.asarray(in1)[[3, 0, 9, 3]]
+                                   * np.asarray(in2), rtol=1e-6)
+
+    def test_grad_scatter_adds_duplicates(self, rng):
+        in1 = jnp.asarray(rng.randn(5, 3).astype(np.float32))
+        in2 = jnp.asarray(rng.randn(2, 3).astype(np.float32))
+        idx = jnp.asarray([1, 1])  # duplicate row: grads must accumulate
+        g = jax.grad(lambda a: jnp.sum(index_mul_2d(a, in2, idx)))(in1)
+        np.testing.assert_allclose(np.asarray(g)[1],
+                                   np.asarray(in2).sum(0), rtol=1e-6)
+        assert np.all(np.asarray(g)[[0, 2, 3, 4]] == 0)
+
+
+class TestGroupNorm:
+    def test_matches_reference(self, rng):
+        x = jnp.asarray(rng.randn(2, 4, 4, 8).astype(np.float32))
+        m = GroupNorm(num_groups=4, num_channels=8)
+        params = m.init_params()
+        out = m(params, x)
+        xr = np.asarray(x).reshape(2, 16, 4, 2)
+        mean = xr.mean(axis=(1, 3), keepdims=True)
+        var = xr.var(axis=(1, 3), keepdims=True)
+        ref = ((xr - mean) / np.sqrt(var + 1e-5)).reshape(2, 4, 4, 8)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_swish_and_affine(self, rng):
+        x = jnp.asarray(rng.randn(2, 3, 3, 8).astype(np.float32))
+        m = GroupNorm(2, 8, act="swish")
+        params = {"weight": jnp.asarray(rng.rand(8).astype(np.float32)),
+                  "bias": jnp.asarray(rng.randn(8).astype(np.float32))}
+        out = m(params, x)
+        plain = group_norm_nhwc(x, 2, params["weight"], params["bias"])
+        ref = np.asarray(plain) / (1 + np.exp(-np.asarray(plain)))
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_bf16_io(self, rng):
+        x = jnp.asarray(rng.randn(1, 4, 4, 16), jnp.bfloat16)
+        m = GroupNorm(4, 16)
+        out = m(m.init_params(), x)
+        assert out.dtype == jnp.bfloat16
+
+
+class TestTransducer:
+    def _numpy_rnnt_loss(self, x, label, t_len, u_len, blank=0):
+        """Textbook O(T·U) DP in numpy."""
+        T, U1, V = x.shape
+        alpha = np.full((t_len, u_len + 1), -np.inf)
+        alpha[0, 0] = 0.0
+        for t in range(t_len):
+            for u in range(u_len + 1):
+                if t == 0 and u == 0:
+                    continue
+                cands = []
+                if t > 0:
+                    cands.append(alpha[t - 1, u] + x[t - 1, u, blank])
+                if u > 0:
+                    cands.append(alpha[t, u - 1]
+                                 + x[t, u - 1, label[u - 1]])
+                alpha[t, u] = np.logaddexp.reduce(cands)
+        return -(alpha[t_len - 1, u_len] + x[t_len - 1, u_len, blank])
+
+    def test_loss_matches_numpy_dp(self, rng):
+        B, T, U, V = 3, 7, 4, 6
+        x = jax.nn.log_softmax(
+            jnp.asarray(rng.randn(B, T, U + 1, V).astype(np.float32)),
+            axis=-1)
+        label = jnp.asarray(rng.randint(1, V, (B, U)))
+        f_len = jnp.asarray([7, 5, 6])
+        y_len = jnp.asarray([4, 2, 3])
+        out = transducer_loss(x, label, f_len, y_len, blank_idx=0)
+        for b in range(B):
+            ref = self._numpy_rnnt_loss(np.asarray(x[b]),
+                                        np.asarray(label[b]),
+                                        int(f_len[b]), int(y_len[b]))
+            np.testing.assert_allclose(float(out[b]), ref, rtol=1e-4)
+
+    def test_loss_grad_finite(self, rng):
+        B, T, U, V = 2, 5, 3, 4
+        raw = jnp.asarray(rng.randn(B, T, U + 1, V).astype(np.float32))
+        label = jnp.asarray(rng.randint(1, V, (B, U)))
+        f_len = jnp.asarray([5, 4])
+        y_len = jnp.asarray([3, 2])
+
+        def loss(raw):
+            x = jax.nn.log_softmax(raw, axis=-1)
+            return jnp.sum(transducer_loss(x, label, f_len, y_len))
+
+        g = jax.jit(jax.grad(loss))(raw)
+        assert np.all(np.isfinite(g))
+        # grads beyond f_len must be zero (frozen lattice rows)
+        np.testing.assert_allclose(np.asarray(g)[1, 4], 0.0, atol=1e-6)
+
+    def test_joint_dense_and_relu(self, rng):
+        f = jnp.asarray(rng.randn(2, 5, 8).astype(np.float32))
+        g = jnp.asarray(rng.randn(2, 3, 8).astype(np.float32))
+        joint = TransducerJoint(relu=True)
+        out = joint(f, g)
+        ref = np.maximum(np.asarray(f)[:, :, None, :]
+                         + np.asarray(g)[:, None, :, :], 0)
+        np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+    def test_joint_packed(self, rng):
+        f = jnp.asarray(rng.randn(2, 4, 6).astype(np.float32))
+        g = jnp.asarray(rng.randn(2, 3, 6).astype(np.float32))
+        f_len = jnp.asarray([3, 4])
+        g_len = jnp.asarray([2, 3])
+        sizes = [3 * 2, 4 * 3]
+        offsets = jnp.asarray([0, sizes[0]])
+        total = sum(sizes)
+        out = transducer_joint(f, g, f_len, g_len, pack_output=True,
+                               batch_offsets=offsets, packed_batch=total)
+        dense = np.asarray(f)[:, :, None, :] + np.asarray(g)[:, None, :, :]
+        pos = 0
+        for b in range(2):
+            for t in range(int(f_len[b])):
+                for u in range(int(g_len[b])):
+                    np.testing.assert_allclose(out[pos], dense[b, t, u],
+                                               rtol=1e-6)
+                    pos += 1
+
+    def test_loss_module_surface(self, rng):
+        x = jax.nn.log_softmax(
+            jnp.asarray(rng.randn(1, 4, 3, 5).astype(np.float32)), -1)
+        loss = TransducerLoss()(x, jnp.asarray([[1, 2]]),
+                                jnp.asarray([4]), jnp.asarray([2]))
+        assert loss.shape == (1,)
+
+
+class TestASP:
+    def test_mask_pattern_2_of_4(self, rng):
+        w = jnp.asarray(rng.randn(32, 64).astype(np.float32))
+        mask = create_mask(w)
+        m = np.asarray(mask).reshape(32, 16, 4)
+        assert (m.sum(-1) == 2).all()
+        # kept entries are the 2 largest magnitudes per group
+        mag = np.abs(np.asarray(w)).reshape(32, 16, 4)
+        kept_min = np.where(m, mag, np.inf).min(-1)
+        dropped_max = np.where(~m, mag, -np.inf).max(-1)
+        assert (kept_min >= dropped_max).all()
+
+    def test_compute_and_apply_masks(self, rng):
+        params = {"w": jnp.asarray(rng.randn(64, 64).astype(np.float32)),
+                  "b": jnp.asarray(rng.randn(64).astype(np.float32))}
+        asp = ASP()
+        masks = asp.compute_sparse_masks(params)
+        sparse = asp.apply_masks(params, masks)
+        assert float(jnp.mean(sparse["w"] == 0)) == 0.5
+        np.testing.assert_array_equal(np.asarray(sparse["b"]),
+                                      np.asarray(params["b"]))  # not pruned
+
+    def test_wrapped_step_remasks(self, rng):
+        from apex_tpu.optimizers import FusedSGD
+
+        params = {"w": jnp.asarray(rng.randn(32, 32).astype(np.float32))}
+        asp = ASP()
+        masks = asp.compute_sparse_masks(params)
+        params = asp.apply_masks(params, masks)
+        opt = FusedSGD(lr=0.1, block_rows=8)
+        state = opt.init(params)
+        step = asp.wrap_optimizer_step(opt.step, masks)
+        grads = {"w": jnp.asarray(rng.randn(32, 32).astype(np.float32))}
+        new_params, _ = step(grads, params, state)
+        m = np.asarray(masks["w"])
+        assert (np.asarray(new_params["w"])[~m] == 0).all()
+        assert (np.asarray(new_params["w"])[m] != 0).any()
+
+
+class TestGroupBN:
+    def test_train_matches_reference_and_running_stats(self, rng):
+        from apex_tpu.contrib.groupbn import BatchNorm2d_NHWC
+
+        x = jnp.asarray(rng.randn(4, 3, 3, 8).astype(np.float32))
+        m = BatchNorm2d_NHWC(8, momentum=0.8)
+        params, state = m.init_params(), m.init_state()
+        y, new_state = m(params, state, x, training=True)
+        xn = np.asarray(x)
+        mean = xn.mean(axis=(0, 1, 2))
+        var = xn.var(axis=(0, 1, 2))
+        ref = (xn - mean) / np.sqrt(var + 1e-5)
+        np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-5)
+        n = xn.size // 8
+        np.testing.assert_allclose(np.asarray(new_state["running_var"]),
+                                   0.8 * 1.0 + 0.2 * var * n / (n - 1),
+                                   rtol=1e-4)
+
+    def test_fused_addrelu(self, rng):
+        from apex_tpu.contrib.groupbn import BatchNorm2d_NHWC
+
+        x = jnp.asarray(rng.randn(2, 3, 3, 4).astype(np.float32))
+        z = jnp.asarray(rng.randn(2, 3, 3, 4).astype(np.float32))
+        m = BatchNorm2d_NHWC(4)
+        params, state = m.init_params(), m.init_state()
+        y, _ = m(params, state, x, z=z, training=True)
+        y_plain, _ = m(params, state, x, training=True)
+        ref = np.maximum(np.asarray(y_plain) + np.asarray(z), 0)
+        np.testing.assert_allclose(y, ref, rtol=1e-5, atol=1e-6)
+
+    def test_eval_uses_running_stats(self, rng):
+        from apex_tpu.contrib.groupbn import BatchNorm2d_NHWC
+
+        x = jnp.asarray(rng.randn(2, 2, 2, 4).astype(np.float32))
+        m = BatchNorm2d_NHWC(4)
+        params = m.init_params()
+        state = {"running_mean": jnp.asarray([1.0, 0, 0, 0]),
+                 "running_var": jnp.full((4,), 2.0)}
+        y, same = m(params, state, x, training=False)
+        ref = (np.asarray(x) - np.asarray([1.0, 0, 0, 0])) / np.sqrt(
+            2.0 + 1e-5)
+        np.testing.assert_allclose(y, ref, rtol=1e-5, atol=1e-6)
+        assert same is state
+
+    def test_sync_over_mesh_axis(self, rng):
+        from apex_tpu.contrib.groupbn import BatchNorm2d_NHWC
+        from jax.sharding import PartitionSpec as P
+
+        mesh = jax.make_mesh((8,), ("data",))
+        x = jnp.asarray(rng.randn(8, 2, 2, 4).astype(np.float32))
+        m = BatchNorm2d_NHWC(4, axis_name="data")
+        params, state = m.init_params(), m.init_state()
+
+        def f(x):
+            y, st = m(params, state, x, training=True)
+            return y, st["running_mean"]
+
+        y, rmean = jax.shard_map(
+            f, mesh=mesh, in_specs=(P("data"),),
+            out_specs=(P("data"), P()), check_vma=False)(x)
+        # stats over the GLOBAL batch == serial reference
+        m_serial = BatchNorm2d_NHWC(4)
+        y_ref, st_ref = m_serial(params, state, x, training=True)
+        np.testing.assert_allclose(y, y_ref, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(rmean,
+                                   np.asarray(st_ref["running_mean"]),
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestFastLayerNorm:
+    def test_surface(self, rng):
+        m = FastLayerNorm(64)
+        params = m.init_params()
+        x = jnp.asarray(rng.randn(8, 64).astype(np.float32))
+        out = m(params, x)
+        ref = (x - x.mean(-1, keepdims=True)) / jnp.sqrt(
+            x.var(-1, keepdims=True) + 1e-5)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
