@@ -34,8 +34,12 @@ class BatchNorm2d_NHWC:
         self.momentum = float(momentum)
         self.fuse_relu = bool(fuse_relu)
         # bn_group>1 in the reference = stats over a device group; here
-        # any axis_name means "reduce stats over that mesh axis"
-        self.axis_name = axis_name if (axis_name or bn_group > 1) else None
+        # that group IS a mesh axis, so cross-device stats require one
+        if bn_group > 1 and axis_name is None:
+            raise ValueError(
+                "bn_group>1 requires axis_name: on TPU the device group is "
+                "a named mesh axis (stats are psummed over it)")
+        self.axis_name = axis_name
         self.param_dtype = param_dtype
 
     def init_params(self):

@@ -50,8 +50,8 @@ class PeerHaloExchanger1d:
         self.dim = int(dim)
 
     def __call__(self, x, halo=None):
-        return halo_exchange_1d(x, halo or self.halo, self.axis_name,
-                                self.dim)
+        return halo_exchange_1d(x, self.halo if halo is None else halo,
+                                self.axis_name, self.dim)
 
 
 class PeerMemoryPool:

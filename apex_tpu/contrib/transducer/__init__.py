@@ -57,7 +57,6 @@ def transducer_joint(f, g, f_len=None, g_len=None, pack_output=False,
         raise ValueError("pack_output needs f_len, g_len, batch_offsets "
                          "and a static packed_batch")
     b, t_max, u_max, hidden = h.shape
-    bb = jnp.arange(b)[:, None, None]
     tt = jnp.arange(t_max)[None, :, None]
     uu = jnp.arange(u_max)[None, None, :]
     valid = (tt < f_len[:, None, None]) & (uu < g_len[:, None, None])
@@ -66,7 +65,6 @@ def transducer_joint(f, g, f_len=None, g_len=None, pack_output=False,
     out = jnp.zeros((packed_batch + 1, hidden), h.dtype)
     out = out.at[dest.reshape(-1)].set(
         h.reshape(-1, hidden), mode="drop")
-    del bb
     return out[:packed_batch]
 
 

@@ -42,7 +42,7 @@ def _has_axis(axis_name) -> bool:
 
 
 def allreduce_gradients(grads, axis_name: str = DEFAULT_DATA_AXIS,
-                        average: bool = True):
+                        average: bool = True, strict: bool = False):
     """Reduce a gradient pytree across the data-parallel axis.
 
     Inside ``shard_map``/``pmap`` this is one fused ``psum`` over the whole
@@ -54,12 +54,13 @@ def allreduce_gradients(grads, axis_name: str = DEFAULT_DATA_AXIS,
     treated as already-summed gradients (JAX auto-psums grads of replicated
     params): the psum is skipped but averaging still divides by world size.
     This is a gradient-reduction helper, not a general replicated-value
-    allreduce.
+    allreduce; ``strict=True`` raises on device-invariant leaves instead
+    of passing them through.
     """
     # Grads computed without mark_local arrive device-INVARIANT — JAX 0.9
     # auto-psummed them during grad-of-replicated-params — and psumming
     # again would multiply by axis size.  Reduce only the varying leaves.
-    reduced = psum_if_varying(grads, axis_name)
+    reduced = psum_if_varying(grads, axis_name, strict=strict)
     if average:
         n = jax.lax.axis_size(axis_name)
         reduced = jax.tree_util.tree_map(lambda g: g / n, reduced)

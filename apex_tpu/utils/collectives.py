@@ -34,7 +34,7 @@ def is_varying(x, axis_name) -> bool:
     return axis_name in jax.typeof(x).vma
 
 
-def psum_if_varying(tree, axis_name):
+def psum_if_varying(tree, axis_name, strict: bool = False):
     """``psum`` only the leaves that are actually device-varying.
 
     An *invariant* leaf inside ``shard_map`` holds the same value on every
@@ -43,10 +43,20 @@ def psum_if_varying(tree, axis_name):
     would multiply by axis size.  Such leaves pass through unchanged,
     treated as ALREADY-SUMMED: callers that average afterwards still divide
     them by axis size.  Pass a value that is replicated-but-not-a-sum and
-    that division is wrong — these helpers are for gradients.
+    that division is wrong — these helpers are for gradients only.
+
+    ``strict=True`` makes that contract loud: any invariant leaf raises
+    instead of silently passing through, for callers who expect every leaf
+    to be a locally-computed (varying) gradient.
     """
-    def one(v):
+    def one(path, v):
         if is_varying(v, axis_name):
             return jax.lax.psum(v, axis_name)
+        if strict:
+            raise ValueError(
+                f"psum_if_varying(strict=True): leaf {jax.tree_util.keystr(path)} "
+                f"is device-invariant over axis {axis_name!r}; it would be "
+                "passed through as an already-summed gradient. If this leaf "
+                "is not a gradient, do not route it through this helper.")
         return v
-    return jax.tree_util.tree_map(one, tree)
+    return jax.tree_util.tree_map_with_path(one, tree)
