@@ -1,0 +1,108 @@
+"""Direct-storage tensor save/load (reference:
+``apex/contrib/gpu_direct_storage/*.py`` + ``csrc/gpu_direct_storage/*.cpp``,
+cuFile-based GPU<->disk DMA).
+
+On TPU there is no cuFile: arrays live in HBM and reach disk through host
+RAM.  The bottleneck this package removes is the *host* stage — python
+pickle + single-threaded read()/write().  Tensors are written as a raw
+contiguous buffer with a tiny JSON header via the native host runtime
+(``apex_tpu/csrc/host_runtime.cpp``: per-thread fds, parallel
+pread/pwrite), and pytrees are packed into ONE buffer with the
+multi-threaded gather before a single parallel write.
+
+Surface (the reference exposes torch.save-like ``save``/``load``):
+
+    save(path, array_or_pytree)     load(path)
+    save_numpy / load_numpy         single-array raw format
+    save_pytree / load_pytree       packed multi-array format
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+
+import numpy as np
+
+from apex_tpu.utils import native
+
+_MAGIC = b"APXT"
+
+
+def _tohost(x) -> np.ndarray:
+    # jax arrays (device or committed) -> host numpy without copies beyond
+    # the device->host transfer itself
+    return np.asarray(x)
+
+
+def save_numpy(path: str, arr, threads: int = 4) -> None:
+    a = np.ascontiguousarray(_tohost(arr))
+    hdr = json.dumps({"dtype": a.dtype.str, "shape": list(a.shape)}).encode()
+    payload = np.empty((len(_MAGIC) + 4 + len(hdr) + a.nbytes,), np.uint8)
+    payload[:4] = np.frombuffer(_MAGIC, np.uint8)
+    payload[4:8] = np.frombuffer(struct.pack("<I", len(hdr)), np.uint8)
+    payload[8:8 + len(hdr)] = np.frombuffer(hdr, np.uint8)
+    payload[8 + len(hdr):] = a.view(np.uint8).reshape(-1)
+    native.file_write(path, payload, threads=threads)
+
+
+def load_numpy(path: str, threads: int = 4) -> np.ndarray:
+    buf = native.file_read(path, threads=threads)
+    assert bytes(buf[:4]) == _MAGIC, f"{path}: not an apex_tpu tensor file"
+    (hlen,) = struct.unpack("<I", bytes(buf[4:8]))
+    meta = json.loads(bytes(buf[8:8 + hlen]))
+    data = buf[8 + hlen:]
+    return data.view(np.dtype(meta["dtype"])).reshape(meta["shape"])
+
+
+def save_pytree(path: str, tree, threads: int = 4) -> None:
+    """One packed buffer + sidecar manifest (``path`` and ``path.json``)."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    arrs = [np.ascontiguousarray(_tohost(x)) for x in leaves]
+    manifest = {
+        "treedef": str(treedef),
+        "leaves": [{"dtype": a.dtype.str, "shape": list(a.shape)}
+                   for a in arrs],
+    }
+    packed = native.pack(arrs)
+    native.file_write(path, packed, threads=threads)
+    with open(path + ".json", "w") as f:
+        json.dump(manifest, f)
+
+
+def load_pytree(path: str, tree_like=None, threads: int = 4):
+    """Load a packed pytree; structure comes from ``tree_like`` (or a flat
+    list of arrays is returned)."""
+    import jax
+
+    with open(path + ".json") as f:
+        manifest = json.load(f)
+    buf = native.file_read(path, threads=threads)
+    arrs = []
+    off = 0
+    for meta in manifest["leaves"]:
+        dt = np.dtype(meta["dtype"])
+        n = int(np.prod(meta["shape"])) * dt.itemsize
+        arrs.append(buf[off:off + n].view(dt).reshape(meta["shape"]))
+        off += n
+    if tree_like is None:
+        return arrs
+    treedef = jax.tree_util.tree_structure(tree_like)
+    return jax.tree_util.tree_unflatten(treedef, arrs)
+
+
+def save(path: str, obj, threads: int = 4) -> None:
+    if isinstance(obj, (np.ndarray,)) or hasattr(obj, "__array__") \
+            and not isinstance(obj, (list, tuple, dict)):
+        save_numpy(path, obj, threads=threads)
+    else:
+        save_pytree(path, obj, threads=threads)
+
+
+def load(path: str, tree_like=None, threads: int = 4):
+    if os.path.exists(path + ".json"):
+        return load_pytree(path, tree_like, threads=threads)
+    return load_numpy(path, threads=threads)
