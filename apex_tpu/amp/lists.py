@@ -66,6 +66,15 @@ PROMOTE = {
     "nextafter",
     "concatenate",
     "select_n",
+    "clamp",
+    # comparisons output bool but still require equal operand dtypes,
+    # which autocast can desynchronize (e.g. bf16 conv out vs f32 const)
+    "eq",
+    "ne",
+    "lt",
+    "le",
+    "gt",
+    "ge",
 }
 
 

@@ -37,6 +37,9 @@ def _tohost(x) -> np.ndarray:
 
 
 def save_numpy(path: str, arr, threads: int = 4) -> None:
+    # a stale pytree sidecar would flip load()'s format dispatch
+    if os.path.exists(path + ".json"):
+        os.unlink(path + ".json")
     a = np.ascontiguousarray(_tohost(arr))
     hdr = json.dumps({"dtype": a.dtype.str, "shape": list(a.shape)}).encode()
     payload = np.empty((len(_MAGIC) + 4 + len(hdr) + a.nbytes,), np.uint8)
@@ -49,7 +52,8 @@ def save_numpy(path: str, arr, threads: int = 4) -> None:
 
 def load_numpy(path: str, threads: int = 4) -> np.ndarray:
     buf = native.file_read(path, threads=threads)
-    assert bytes(buf[:4]) == _MAGIC, f"{path}: not an apex_tpu tensor file"
+    if bytes(buf[:4]) != _MAGIC:
+        raise ValueError(f"{path}: not an apex_tpu tensor file")
     (hlen,) = struct.unpack("<I", bytes(buf[4:8]))
     meta = json.loads(bytes(buf[8:8 + hlen]))
     data = buf[8 + hlen:]

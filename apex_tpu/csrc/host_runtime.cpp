@@ -26,13 +26,12 @@
 #include <unistd.h>
 #include <vector>
 
-extern "C" {
+namespace {
 
-int apex_version() { return 1; }
-
-// Gather: memcpy n source buffers back-to-back into dst.  Large inputs are
-// split across threads at buffer granularity (balanced by bytes).
-int apex_pack(const void **srcs, const size_t *sizes, int n, void *dst) {
+// Gather (GATHER) or scatter (!GATHER) between n separate buffers and one
+// contiguous pack.  Inputs split across threads at buffer granularity.
+template <bool GATHER>
+int copy_many(void *pack, void *const *bufs, const size_t *sizes, int n) {
   if (n < 0) return -EINVAL;
   size_t total = 0;
   std::vector<size_t> offs((size_t)n);
@@ -45,8 +44,13 @@ int apex_pack(const void **srcs, const size_t *sizes, int n, void *dst) {
   if (nt > n) nt = n > 0 ? n : 1;
   if (total < (1u << 20)) nt = 1;  // small packs: thread spawn dominates
   auto run = [&](int t) {
-    for (int i = t; i < n; i += nt)
-      std::memcpy((char *)dst + offs[(size_t)i], srcs[i], sizes[i]);
+    for (int i = t; i < n; i += nt) {
+      char *at = (char *)pack + offs[(size_t)i];
+      if (GATHER)
+        std::memcpy(at, bufs[i], sizes[i]);
+      else
+        std::memcpy(bufs[i], at, sizes[i]);
+    }
   };
   if (nt == 1) {
     run(0);
@@ -58,38 +62,6 @@ int apex_pack(const void **srcs, const size_t *sizes, int n, void *dst) {
   }
   return 0;
 }
-
-// Scatter: inverse of apex_pack.
-int apex_unpack(const void *src, void **dsts, const size_t *sizes, int n) {
-  if (n < 0) return -EINVAL;
-  size_t total = 0;
-  std::vector<size_t> offs((size_t)n);
-  for (int i = 0; i < n; ++i) {
-    offs[(size_t)i] = total;
-    total += sizes[i];
-  }
-  unsigned hw = std::thread::hardware_concurrency();
-  int nt = (int)(hw ? hw : 1);
-  if (nt > n) nt = n > 0 ? n : 1;
-  if (total < (1u << 20)) nt = 1;
-  auto run = [&](int t) {
-    for (int i = t; i < n; i += nt)
-      std::memcpy(dsts[i], (const char *)src + offs[(size_t)i], sizes[i]);
-  };
-  if (nt == 1) {
-    run(0);
-  } else {
-    std::vector<std::thread> ts;
-    ts.reserve((size_t)nt);
-    for (int t = 0; t < nt; ++t) ts.emplace_back(run, t);
-    for (auto &th : ts) th.join();
-  }
-  return 0;
-}
-
-}  // extern "C"
-
-namespace {
 
 // Parallel chunked file IO: each thread opens its own fd and
 // preads/pwrites a contiguous slice, so the kernel can keep multiple
@@ -153,6 +125,16 @@ int file_io(const char *path, void *buf, size_t size, int threads) {
 }  // namespace
 
 extern "C" {
+
+int apex_version() { return 1; }
+
+int apex_pack(const void **srcs, const size_t *sizes, int n, void *dst) {
+  return copy_many<true>(dst, const_cast<void *const *>(srcs), sizes, n);
+}
+
+int apex_unpack(const void *src, void **dsts, const size_t *sizes, int n) {
+  return copy_many<false>(const_cast<void *>(src), dsts, sizes, n);
+}
 
 int apex_file_write(const char *path, const void *buf, size_t size,
                     int threads) {

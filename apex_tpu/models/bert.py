@@ -1,0 +1,262 @@
+"""BERT model family — the FusedLAMB/amp-O2 recipe workload (reference:
+apex's MLPerf-BERT lineage: ``apex/contrib/fmha`` kernels are built for
+BERT seq<=512, ``DistributedFusedLAMB`` exists for BERT-large pretrain,
+and BASELINE workload 2 is "BERT-large pretrain, FusedLAMB +
+FusedLayerNorm + amp O2").
+
+Same component wiring as the GPT flagship — VocabParallelEmbedding,
+Column/RowParallelLinear, MixedFusedLayerNorm (Pallas), flash attention
+(non-causal, padding via ``kv_seqlens``), vocab-parallel cross entropy —
+in the encoder arrangement: learned position + segment embeddings,
+post-LN blocks, MLM head with tied decoder + NSP pooler head.
+
+Masked-LM convention: ``mlm_labels`` holds the original token id at
+masked positions and ``-1`` everywhere else (apex/Megatron's
+``labels``/``loss_mask`` pair collapsed into one array).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.normalization import MixedFusedLayerNorm
+from apex_tpu.ops.flash_attention import flash_attention
+from apex_tpu.transformer import tensor_parallel as tp
+
+_f32 = jnp.float32
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30528                    # MLPerf padded vocab
+    hidden_size: int = 1024                    # BERT-large
+    num_layers: int = 24
+    num_attention_heads: int = 16
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    ffn_hidden_size: Optional[int] = None      # default 4*hidden
+    tensor_parallel_size: int = 1
+    axis_name: Optional[str] = None
+    sequence_parallel: bool = False
+    remat: bool = False
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    def __post_init__(self):
+        if self.ffn_hidden_size is None:
+            self.ffn_hidden_size = 4 * self.hidden_size
+        if self.hidden_size % self.num_attention_heads:
+            raise ValueError(
+                "hidden_size must be divisible by num_attention_heads")
+        if self.num_attention_heads % self.tensor_parallel_size:
+            raise ValueError("num_attention_heads must be divisible by "
+                             "tensor_parallel_size")
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+class BertSelfAttention:
+    """Bidirectional self-attention; padding handled by the flash
+    kernel's ``kv_seqlens`` (the reference fmha's cu_seqlens packing)."""
+
+    def __init__(self, cfg: BertConfig):
+        self.cfg = cfg
+        self.qkv = tp.ColumnParallelLinear(
+            cfg.hidden_size, 3 * cfg.hidden_size, gather_output=False,
+            world_size=cfg.tensor_parallel_size, axis_name=cfg.axis_name,
+            sequence_parallel_enabled=cfg.sequence_parallel,
+            param_dtype=cfg.param_dtype)
+        self.proj = tp.RowParallelLinear(
+            cfg.hidden_size, cfg.hidden_size, input_is_parallel=True,
+            world_size=cfg.tensor_parallel_size, axis_name=cfg.axis_name,
+            sequence_parallel_enabled=cfg.sequence_parallel,
+            param_dtype=cfg.param_dtype)
+
+    def init_params(self, key):
+        k1, k2 = jax.random.split(key)
+        return {"qkv": self.qkv.init_params(k1),
+                "proj": self.proj.init_params(k2)}
+
+    def __call__(self, params, x, seqlens=None):
+        cfg = self.cfg
+        b = x.shape[0]
+        qkv, _ = self.qkv(params["qkv"], x)
+        s = qkv.shape[1]
+        nh = qkv.shape[-1] // (3 * cfg.head_dim)
+        qkv = qkv.reshape(b, s, nh, 3 * cfg.head_dim)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.transpose(0, 2, 1, 3)
+        k = k.transpose(0, 2, 1, 3)
+        v = v.transpose(0, 2, 1, 3)
+        ctx = flash_attention(q, k, v, causal=False, kv_seqlens=seqlens)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, nh * cfg.head_dim)
+        out, _ = self.proj(params["proj"], ctx)
+        return out
+
+
+class BertLayer:
+    """Post-LN block (original BERT arrangement: residual→LN)."""
+
+    def __init__(self, cfg: BertConfig):
+        self.cfg = cfg
+        self.attention = BertSelfAttention(cfg)
+        self.attention_layernorm = MixedFusedLayerNorm(cfg.hidden_size)
+        self.fc1 = tp.ColumnParallelLinear(
+            cfg.hidden_size, cfg.ffn_hidden_size, gather_output=False,
+            world_size=cfg.tensor_parallel_size, axis_name=cfg.axis_name,
+            sequence_parallel_enabled=cfg.sequence_parallel,
+            param_dtype=cfg.param_dtype)
+        self.fc2 = tp.RowParallelLinear(
+            cfg.ffn_hidden_size, cfg.hidden_size, input_is_parallel=True,
+            world_size=cfg.tensor_parallel_size, axis_name=cfg.axis_name,
+            sequence_parallel_enabled=cfg.sequence_parallel,
+            param_dtype=cfg.param_dtype)
+        self.output_layernorm = MixedFusedLayerNorm(cfg.hidden_size)
+
+    def init_params(self, key):
+        k1, k2, k3 = jax.random.split(key, 3)
+        return {"attention": self.attention.init_params(k1),
+                "attention_layernorm":
+                    self.attention_layernorm.init_params(),
+                "fc1": self.fc1.init_params(k2),
+                "fc2": self.fc2.init_params(k3),
+                "output_layernorm": self.output_layernorm.init_params()}
+
+    def __call__(self, params, x, seqlens=None):
+        h = self.attention(params["attention"], x, seqlens)
+        x = self.attention_layernorm(params["attention_layernorm"], x + h)
+        h, _ = self.fc1(params["fc1"], x)
+        h = jax.nn.gelu(h, approximate=True)
+        h, _ = self.fc2(params["fc2"], h)
+        return self.output_layernorm(params["output_layernorm"], x + h)
+
+
+class BertModel:
+    """Encoder + MLM/NSP heads.
+
+    ``apply(params, tokens, token_type_ids=None, seqlens=None)`` returns
+    the final hidden states; ``loss`` computes MLM (+ optional NSP) with
+    vocab-parallel cross entropy over the tied decoder.
+    """
+
+    def __init__(self, cfg: BertConfig):
+        self.cfg = cfg
+        self.embedding = tp.VocabParallelEmbedding(
+            cfg.vocab_size, cfg.hidden_size,
+            world_size=cfg.tensor_parallel_size, axis_name=cfg.axis_name,
+            param_dtype=cfg.param_dtype)
+        self.embedding_layernorm = MixedFusedLayerNorm(cfg.hidden_size)
+        self.layers = [BertLayer(cfg) for _ in range(cfg.num_layers)]
+        self.mlm_layernorm = MixedFusedLayerNorm(cfg.hidden_size)
+
+    def init_params(self, key):
+        keys = jax.random.split(key, self.cfg.num_layers + 4)
+        cfg = self.cfg
+        init = lambda k, *s: 0.02 * jax.random.normal(k, s, cfg.param_dtype)
+        return {
+            "embedding": self.embedding.init_params(keys[0]),
+            "position_embedding": init(keys[1], cfg.max_seq_len,
+                                       cfg.hidden_size),
+            "token_type_embedding": init(keys[2], cfg.type_vocab_size,
+                                         cfg.hidden_size),
+            "embedding_layernorm": self.embedding_layernorm.init_params(),
+            "layers": [l.init_params(k)
+                       for l, k in zip(self.layers, keys[3:-1])],
+            "mlm_transform": {
+                "weight": init(keys[-1], cfg.hidden_size, cfg.hidden_size),
+                "bias": jnp.zeros((cfg.hidden_size,), cfg.param_dtype)},
+            "mlm_layernorm": self.mlm_layernorm.init_params(),
+            "nsp_head": {
+                "weight": jnp.zeros((cfg.hidden_size, 2), cfg.param_dtype),
+                "bias": jnp.zeros((2,), cfg.param_dtype)},
+        }
+
+    def apply(self, params, tokens, token_type_ids=None, seqlens=None):
+        cfg = self.cfg
+        x = self.embedding(params["embedding"], tokens)
+        x = x + params["position_embedding"][:tokens.shape[1]]
+        if token_type_ids is None:
+            x = x + params["token_type_embedding"][0]
+        else:
+            x = x + jnp.take(params["token_type_embedding"],
+                             token_type_ids, axis=0)
+        x = self.embedding_layernorm(params["embedding_layernorm"], x)
+        x = x.astype(cfg.dtype)
+        for layer, lp in zip(self.layers, params["layers"]):
+            if cfg.remat:
+                x = jax.checkpoint(
+                    lambda lp, x, sl, _l=layer: _l(lp, x, sl))(
+                        lp, x, seqlens)
+            else:
+                x = layer(lp, x, seqlens)
+        return x
+
+    __call__ = apply
+
+    def mlm_logits(self, params, hidden):
+        """Tied-decoder vocab(-parallel) logits ``(b, s, vocab/t)``."""
+        h = (hidden.astype(_f32)
+             @ params["mlm_transform"]["weight"].astype(_f32)
+             + params["mlm_transform"]["bias"].astype(_f32))
+        h = jax.nn.gelu(h, approximate=True)
+        h = self.mlm_layernorm(params["mlm_layernorm"], h)
+        w = params["embedding"]["weight"]
+        return jnp.einsum("bsh,vh->bsv", h.astype(_f32), w.astype(_f32))
+
+    def loss(self, params, tokens, mlm_labels, token_type_ids=None,
+             seqlens=None, nsp_labels=None):
+        """Mean MLM loss over masked positions (+ NSP when labels given).
+
+        ``mlm_labels``: original ids at masked positions, -1 elsewhere.
+        """
+        hidden = self.apply(params, tokens, token_type_ids, seqlens)
+        logits = self.mlm_logits(params, hidden)
+        b, s, vl = logits.shape
+        mask = (mlm_labels >= 0)
+        safe = jnp.where(mask, mlm_labels, 0)
+        per = tp.vocab_parallel_cross_entropy(
+            logits.reshape(b * s, vl), safe.reshape(b * s),
+            axis_name=self.cfg.axis_name).reshape(b, s)
+        denom = jnp.maximum(jnp.sum(mask), 1)
+        loss = jnp.sum(jnp.where(mask, per, 0.0)) / denom
+        if nsp_labels is not None:
+            pooled = jnp.tanh(hidden[:, 0].astype(_f32))
+            nsp = (pooled @ params["nsp_head"]["weight"].astype(_f32)
+                   + params["nsp_head"]["bias"].astype(_f32))
+            logp = jax.nn.log_softmax(nsp)
+            loss = loss - jnp.mean(
+                jnp.take_along_axis(logp, nsp_labels[:, None], 1))
+        return loss
+
+    # -- GSPMD form ---------------------------------------------------------
+
+    def partition_specs(self):
+        """PartitionSpecs for jitting the serial form under GSPMD (same
+        contract as :meth:`GPTModel.partition_specs`)."""
+        from jax.sharding import PartitionSpec as P
+        l0 = self.layers[0]
+        ln = {"weight": P(), "bias": P()}
+        layer_spec = {
+            "attention": {"qkv": l0.attention.qkv.partition_spec(),
+                          "proj": l0.attention.proj.partition_spec()},
+            "attention_layernorm": ln,
+            "fc1": l0.fc1.partition_spec(),
+            "fc2": l0.fc2.partition_spec(),
+            "output_layernorm": ln,
+        }
+        return {
+            "embedding": self.embedding.partition_spec(),
+            "position_embedding": P(),
+            "token_type_embedding": P(),
+            "embedding_layernorm": ln,
+            "layers": [layer_spec] * self.cfg.num_layers,
+            "mlm_transform": {"weight": P(), "bias": P()},
+            "mlm_layernorm": ln,
+            "nsp_head": {"weight": P(), "bias": P()},
+        }

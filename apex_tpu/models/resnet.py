@@ -1,0 +1,208 @@
+"""ResNet model family for the imagenet example + SyncBN/bottleneck tests
+(reference: apex's flagship CNN workload — ``examples/imagenet/main_amp.py``
+trains torchvision ResNet-50 under amp; apex itself supplies the fused
+pieces: SyncBatchNorm, groupbn NHWC, contrib.bottleneck).
+
+TPU-first layout: **NHWC** everywhere (the MXU-friendly conv layout; the
+reference's NHWC path is its fast case too), batch norm via the framework's
+functional :func:`apex_tpu.parallel.sync_batchnorm.sync_batch_norm` so a
+single ``axis_name`` switch turns every BN into cross-device SyncBN for the
+Mask-R-CNN-style recipes (BASELINE workload 4).
+
+Functional state: ``params`` (trainable) and ``state`` (BN running stats)
+are separate pytrees; ``apply`` returns ``(logits, new_state)``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.parallel.sync_batchnorm import BatchNormState, sync_batch_norm
+
+_f32 = jnp.float32
+_DN = ("NHWC", "HWIO", "NHWC")
+
+
+@dataclasses.dataclass
+class ResNetConfig:
+    depths: Sequence[int] = (3, 4, 6, 3)       # ResNet-50
+    width: int = 64
+    num_classes: int = 1000
+    axis_name: Optional[str] = None            # SyncBN over this mesh axis
+    bn_momentum: float = 0.1
+    bn_eps: float = 1e-5
+    dtype: jnp.dtype = jnp.float32             # activation/compute dtype
+    param_dtype: jnp.dtype = jnp.float32
+
+    @property
+    def stage_channels(self):
+        return [self.width * (2 ** i) for i in range(len(self.depths))]
+
+
+def resnet50(**kw) -> "ResNet":
+    return ResNet(ResNetConfig(depths=(3, 4, 6, 3), **kw))
+
+
+def resnet18(**kw) -> "ResNet":
+    # basic-block resnets are out of scope; 18 maps to a thin bottleneck
+    return ResNet(ResNetConfig(depths=(2, 2, 2, 2), **kw))
+
+
+def _conv_init(key, kh, kw, cin, cout, dtype):
+    fan_in = kh * kw * cin
+    return jax.random.normal(key, (kh, kw, cin, cout), dtype) * fan_in ** -0.5
+
+
+def _conv(x, w, stride=1, padding="SAME"):
+    return jax.lax.conv_general_dilated(
+        x, w.astype(x.dtype), window_strides=(stride, stride),
+        padding=padding, dimension_numbers=_DN)
+
+
+class _ConvBN:
+    """conv → BN(→ReLU) unit; BN is SyncBN when cfg.axis_name is set."""
+
+    def __init__(self, cfg, kh, kw, cin, cout, stride=1):
+        self.cfg, self.kh, self.kw = cfg, kh, kw
+        self.cin, self.cout, self.stride = cin, cout, stride
+
+    def init_params(self, key):
+        return {"weight": _conv_init(key, self.kh, self.kw, self.cin,
+                                     self.cout, self.cfg.param_dtype),
+                "bn_weight": jnp.ones((self.cout,), _f32),
+                "bn_bias": jnp.zeros((self.cout,), _f32)}
+
+    def init_state(self):
+        return BatchNormState(jnp.zeros((self.cout,), _f32),
+                              jnp.ones((self.cout,), _f32),
+                              jnp.zeros((), jnp.int32))
+
+    def __call__(self, params, state, x, *, training, relu=True):
+        h = _conv(x, params["weight"], self.stride)
+        h, new_state = sync_batch_norm(
+            h, params["bn_weight"], params["bn_bias"], state,
+            training=training, momentum=self.cfg.bn_momentum,
+            eps=self.cfg.bn_eps, axis_name=self.cfg.axis_name,
+            channel_last=True)
+        if relu:
+            h = jax.nn.relu(h)
+        return h, new_state
+
+
+class _BottleneckBlock:
+    """1x1 → 3x3(stride) → 1x1(×4) + residual, trainable BN (torchvision
+    Bottleneck; the frozen-BN fused variant is
+    ``apex_tpu.contrib.bottleneck.Bottleneck``)."""
+
+    def __init__(self, cfg, cin, cmid, stride):
+        cout = 4 * cmid
+        self.units = {
+            "conv1": _ConvBN(cfg, 1, 1, cin, cmid),
+            "conv2": _ConvBN(cfg, 3, 3, cmid, cmid, stride),
+            "conv3": _ConvBN(cfg, 1, 1, cmid, cout),
+        }
+        self.downsample = None
+        if stride != 1 or cin != cout:
+            self.downsample = _ConvBN(cfg, 1, 1, cin, cout, stride)
+        self.cout = cout
+
+    def init_params(self, key):
+        names = list(self.units) + (["downsample"] if self.downsample
+                                    else [])
+        keys = jax.random.split(key, len(names))
+        out = {n: self.units[n].init_params(k)
+               for n, k in zip(names, keys) if n in self.units}
+        if self.downsample:
+            out["downsample"] = self.downsample.init_params(keys[-1])
+        return out
+
+    def init_state(self):
+        out = {n: u.init_state() for n, u in self.units.items()}
+        if self.downsample:
+            out["downsample"] = self.downsample.init_state()
+        return out
+
+    def __call__(self, params, state, x, *, training):
+        ns = {}
+        h, ns["conv1"] = self.units["conv1"](params["conv1"],
+                                             state["conv1"], x,
+                                             training=training)
+        h, ns["conv2"] = self.units["conv2"](params["conv2"],
+                                             state["conv2"], h,
+                                             training=training)
+        h, ns["conv3"] = self.units["conv3"](params["conv3"],
+                                             state["conv3"], h,
+                                             training=training, relu=False)
+        if self.downsample:
+            r, ns["downsample"] = self.downsample(
+                params["downsample"], state["downsample"], x,
+                training=training, relu=False)
+        else:
+            r = x
+        return jax.nn.relu(h + r), ns
+
+
+class ResNet:
+    """apply: ``(params, state, images_nhwc, training) -> (logits,
+    new_state)``; ``loss`` adds softmax cross entropy over classes."""
+
+    def __init__(self, cfg: ResNetConfig):
+        self.cfg = cfg
+        self.stem = _ConvBN(cfg, 7, 7, 3, cfg.width, stride=2)
+        self.blocks = []
+        cin = cfg.width
+        for stage, (depth, cmid) in enumerate(
+                zip(cfg.depths, cfg.stage_channels)):
+            for i in range(depth):
+                stride = 2 if (i == 0 and stage > 0) else 1
+                blk = _BottleneckBlock(cfg, cin, cmid, stride)
+                self.blocks.append(blk)
+                cin = blk.cout
+        self.feat_dim = cin
+
+    def init_params(self, key):
+        keys = jax.random.split(key, len(self.blocks) + 2)
+        head_w = jax.random.normal(
+            keys[-1], (self.feat_dim, self.cfg.num_classes),
+            self.cfg.param_dtype) * self.feat_dim ** -0.5
+        return {
+            "stem": self.stem.init_params(keys[0]),
+            "blocks": [b.init_params(k)
+                       for b, k in zip(self.blocks, keys[1:-1])],
+            "head": {"weight": head_w,
+                     "bias": jnp.zeros((self.cfg.num_classes,),
+                                       self.cfg.param_dtype)},
+        }
+
+    def init_state(self):
+        return {"stem": self.stem.init_state(),
+                "blocks": [b.init_state() for b in self.blocks]}
+
+    def apply(self, params, state, x, training: bool = True):
+        x = x.astype(self.cfg.dtype)
+        h, stem_state = self.stem(params["stem"], state["stem"], x,
+                                  training=training)
+        h = jax.lax.reduce_window(
+            h, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
+            "SAME")
+        block_states = []
+        for blk, p, s in zip(self.blocks, params["blocks"],
+                             state["blocks"]):
+            h, ns = blk(p, s, h, training=training)
+            block_states.append(ns)
+        h = jnp.mean(h, axis=(1, 2))                       # global avg pool
+        logits = (h.astype(_f32) @ params["head"]["weight"].astype(_f32)
+                  + params["head"]["bias"].astype(_f32))
+        return logits, {"stem": stem_state, "blocks": block_states}
+
+    __call__ = apply
+
+    def loss(self, params, state, x, labels, training: bool = True):
+        logits, new_state = self.apply(params, state, x, training=training)
+        logp = jax.nn.log_softmax(logits)
+        nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+        return jnp.mean(nll), new_state

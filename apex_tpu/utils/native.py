@@ -132,7 +132,8 @@ def pack(arrays, out: np.ndarray | None = None) -> np.ndarray:
     srcs = (ctypes.c_void_p * n)(*[v.ctypes.data for v in views])
     sizes = (ctypes.c_size_t * n)(*[v.size for v in views])
     rc = L.apex_pack(srcs, sizes, n, out.ctypes.data)
-    assert rc == 0, f"apex_pack failed: {rc}"
+    if rc != 0:
+        raise OSError(-rc, f"apex_pack failed: {rc}")
     return out
 
 
@@ -142,8 +143,9 @@ def unpack(buf: np.ndarray, arrays) -> None:
     # _as_1d_bytes may copy non-contiguous inputs; require contiguous so
     # the scatter lands in the caller's memory
     for a, v in zip(arrays, views):
-        assert a.__array_interface__["data"][0] == \
-            v.__array_interface__["data"][0], "unpack needs contiguous arrays"
+        if a.__array_interface__["data"][0] != \
+                v.__array_interface__["data"][0]:
+            raise ValueError("unpack needs contiguous destination arrays")
     buf = np.ascontiguousarray(buf).view(np.uint8).reshape(-1)
     L = lib()
     if L is None:
@@ -156,7 +158,8 @@ def unpack(buf: np.ndarray, arrays) -> None:
     dsts = (ctypes.c_void_p * n)(*[v.ctypes.data for v in views])
     sizes = (ctypes.c_size_t * n)(*[v.size for v in views])
     rc = L.apex_unpack(buf.ctypes.data, dsts, sizes, n)
-    assert rc == 0, f"apex_unpack failed: {rc}"
+    if rc != 0:
+        raise OSError(-rc, f"apex_unpack failed: {rc}")
 
 
 def file_write(path: str, buf: np.ndarray, threads: int = 4) -> None:
@@ -169,7 +172,8 @@ def file_write(path: str, buf: np.ndarray, threads: int = 4) -> None:
         return
     rc = L.apex_file_write(path.encode(), v.ctypes.data, v.size,
                            int(threads))
-    assert rc == 0, f"apex_file_write({path}) failed: {rc}"
+    if rc != 0:
+        raise OSError(-rc, f"apex_file_write({path}) failed")
 
 
 def file_read(path: str, nbytes: int | None = None,
@@ -185,5 +189,6 @@ def file_read(path: str, nbytes: int | None = None,
         return out
     rc = L.apex_file_read(path.encode(), out.ctypes.data, size,
                           int(threads))
-    assert rc == 0, f"apex_file_read({path}) failed: {rc}"
+    if rc != 0:
+        raise OSError(-rc, f"apex_file_read({path}) failed")
     return out

@@ -106,6 +106,18 @@ class TestGDS:
         np.testing.assert_array_equal(out["stats"][0], tree["stats"][0])
         np.testing.assert_array_equal(out["stats"][1], tree["stats"][1])
 
+    def test_overwrite_pytree_with_array(self, native_mode, tmp_path):
+        """save(array) over a pytree checkpoint must clear the sidecar so
+        load() dispatches on the new format."""
+        gds = self._gds()
+        p = str(tmp_path / "ck.apxt")
+        gds.save(p, {"w": np.arange(4.0)})
+        a = np.arange(10.0).reshape(2, 5)
+        gds.save(p, a)
+        out = gds.load(p)
+        assert out.shape == (2, 5)
+        np.testing.assert_array_equal(out, a)
+
     def test_jax_array(self, native_mode, tmp_path):
         import jax.numpy as jnp
         gds = self._gds()
