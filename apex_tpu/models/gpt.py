@@ -164,11 +164,16 @@ class ParallelTransformerLayer:
                 "mlp": self.mlp.init_params(k2)}
 
     def __call__(self, params, x, rope_cos=None, rope_sin=None):
-        h = self.input_layernorm(params["input_layernorm"], x)
-        x = x + self.attention(params["attention"], h, rope_cos, rope_sin)
-        h = self.post_attention_layernorm(params["post_attention_layernorm"],
-                                          x)
-        return x + self.mlp(params["mlp"], h)
+        # named scopes land in HLO metadata -> visible in xprof traces
+        # (the reference's nvtx range annotations, SURVEY §5)
+        with jax.named_scope("attention"):
+            h = self.input_layernorm(params["input_layernorm"], x)
+            x = x + self.attention(params["attention"], h, rope_cos,
+                                   rope_sin)
+        with jax.named_scope("mlp"):
+            h = self.post_attention_layernorm(
+                params["post_attention_layernorm"], x)
+            return x + self.mlp(params["mlp"], h)
 
 
 class GPTModel:

@@ -1,0 +1,21 @@
+"""Transformer-stack logging — apex surface parity
+(reference: ``apex/transformer/log_util.py``: ``get_transformer_logger``
+returning a per-module child of the "apex" logger and
+``set_logging_level`` on the root apex logger)."""
+
+from __future__ import annotations
+
+import logging
+
+_ROOT_NAME = "apex_tpu"
+
+
+def get_transformer_logger(name: str) -> logging.Logger:
+    """Child logger under the package root (apex: ``apex.transformer.X``)."""
+    name_wo_ext = name.split(".")[0]
+    return logging.getLogger(f"{_ROOT_NAME}.transformer.{name_wo_ext}")
+
+
+def set_logging_level(verbosity) -> None:
+    """Set the package root logger's level (apex ``set_logging_level``)."""
+    logging.getLogger(_ROOT_NAME).setLevel(verbosity)

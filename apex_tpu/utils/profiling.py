@@ -1,0 +1,121 @@
+"""Profiling + observability utilities (SURVEY §5).
+
+The reference's op-level tracing was ``apex.pyprof`` (removed upstream)
+plus scattered ``torch.cuda.nvtx.range_push/pop`` annotations read by
+Nsight.  The TPU equivalents wired here:
+
+* :func:`annotate` / :func:`range_push` / :func:`range_pop` —
+  ``jax.named_scope`` as the nvtx analogue; scope names survive into XLA
+  HLO metadata and show up in profiler traces.
+* :func:`trace` — ``jax.profiler.trace`` wrapper (TensorBoard-readable).
+* :func:`memory_stats` — compiled-program memory analysis (argument /
+  output / temp bytes), the measurement tool for the pipeline engine's
+  activation-residency claims.
+* :func:`program_hash` / :func:`assert_same_program` — the survey's
+  multi-controller race-safety replacement: XLA programs are data-race
+  free, so the remaining divergence risk is hosts compiling DIFFERENT
+  programs; hash the optimized HLO and compare.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+from typing import Any, Callable
+
+import jax
+
+named_scope = jax.named_scope        # re-export: the nvtx range analogue
+
+_SCOPE_STACK: list = []
+
+
+def range_push(name: str) -> None:
+    """``torch.cuda.nvtx.range_push`` equivalent (paired with
+    :func:`range_pop`); prefer the :func:`annotate` context manager."""
+    cm = jax.named_scope(name)
+    cm.__enter__()
+    _SCOPE_STACK.append(cm)
+
+
+def range_pop() -> None:
+    if _SCOPE_STACK:
+        _SCOPE_STACK.pop().__exit__(None, None, None)
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Named-scope context manager: ops traced inside carry ``name`` in
+    their HLO metadata (visible in xprof/TensorBoard)."""
+    with jax.named_scope(name):
+        yield
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, create_perfetto_link: bool = False):
+    """Capture a device trace viewable in TensorBoard (``jax.profiler``)."""
+    jax.profiler.start_trace(log_dir,
+                             create_perfetto_link=create_perfetto_link)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def memory_stats(fn: Callable, *args, static_argnums=(), **kwargs) -> dict:
+    """Compile ``fn`` for the given args and return its memory analysis.
+
+    Returns a dict with ``argument``, ``output``, ``temp``, ``alias`` and
+    ``generated_code`` sizes in bytes.  ``temp`` is the interesting one
+    for remat/pipeline decisions: it is XLA's peak scratch (live
+    activations + workspaces) beyond inputs/outputs.
+    """
+    lowered = jax.jit(fn, static_argnums=static_argnums,
+                      **kwargs).lower(*args)
+    ma = lowered.compile().memory_analysis()
+    if ma is None:                    # backend without the query
+        return {}
+    return {
+        "argument": int(ma.argument_size_in_bytes),
+        "output": int(ma.output_size_in_bytes),
+        "temp": int(ma.temp_size_in_bytes),
+        "alias": int(ma.alias_size_in_bytes),
+        "generated_code": int(ma.generated_code_size_in_bytes),
+    }
+
+
+def program_hash(fn: Callable, *args, **jit_kwargs) -> str:
+    """sha256 of the program ``jit(fn)`` would run for these args.
+
+    Hashes the stable (unoptimized) StableHLO text, so the value is a
+    fingerprint of the MATH the host built — identical sources on every
+    controller hash identically even if backend optimization differs.
+    """
+    text = jax.jit(fn, **jit_kwargs).lower(*args).as_text()
+    return hashlib.sha256(text.encode()).hexdigest()
+
+
+def assert_same_program(fn_or_hash: Any, *args, **jit_kwargs) -> str:
+    """Multi-controller divergence guard (SURVEY §5: "same program hash on
+    all hosts" in place of race detection).
+
+    Pass either a precomputed hash string or ``(fn, *args)``.  Under
+    multi-controller JAX the hash is all-gathered over hosts and all
+    values must agree; single-controller it's a cheap no-op pass-through.
+    Returns the (verified) hash.
+    """
+    h = (fn_or_hash if isinstance(fn_or_hash, str)
+         else program_hash(fn_or_hash, *args, **jit_kwargs))
+    if jax.process_count() > 1:
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        bits = np.frombuffer(bytes.fromhex(h), np.uint8)
+        gathered = multihost_utils.process_allgather(bits)
+        for rank, other in enumerate(gathered):
+            if not np.array_equal(other, bits):
+                raise AssertionError(
+                    f"program hash divergence: host {jax.process_index()} "
+                    f"has {h}, host {rank} differs — the controllers built "
+                    "different programs")
+    return h

@@ -24,7 +24,9 @@ import numpy as np
 def parse_args():
     p = argparse.ArgumentParser(description="apex_tpu dcgan + amp")
     p.add_argument("--batch-size", type=int, default=128)
-    p.add_argument("--image-size", type=int, default=64)
+    p.add_argument("--image-size", type=int, default=64, choices=[64],
+                   help="the DCGAN topology is fixed at 64x64 (4 stride-2 "
+                        "stages), like the reference architecture")
     p.add_argument("--nz", type=int, default=100, help="latent dim")
     p.add_argument("--ngf", type=int, default=64)
     p.add_argument("--ndf", type=int, default=64)
@@ -86,7 +88,13 @@ def main():
         "head": winit(kd[4], 4 * 4 * ndf * 8, 1),
     }
 
+    # O2/O3 run the nets in bf16: cast the activations entering them
+    # (weights are cast once by cast_params below)
+    half_dtype = (jnp.bfloat16 if args.opt_level in ("O2", "O3")
+                  else jnp.float32)
+
     def generator(p, z):
+        z = z.astype(half_dtype)
         x = z.reshape(z.shape[0], 1, 1, nz)
         x = jax.nn.relu(deconv(x, p["p0"], 4))            # 4x4
         x = jax.nn.relu(deconv(x, p["d1"], 2))            # 8x8
@@ -95,6 +103,7 @@ def main():
         return jnp.tanh(deconv(x, p["d4"], 2))            # 64
 
     def discriminator(p, x):
+        x = x.astype(half_dtype)
         x = lrelu(conv(x, p["c1"], 2))                    # 32
         x = lrelu(conv(x, p["c2"], 2))                    # 16
         x = lrelu(conv(x, p["c3"], 2))                    # 8

@@ -143,8 +143,11 @@ def main():
     bn_state = model.init_state()
 
     loss_scale = args.loss_scale
-    if isinstance(loss_scale, str) and loss_scale not in (None, "dynamic"):
-        loss_scale = float(loss_scale)
+    if isinstance(loss_scale, str):
+        if loss_scale in ("None", "none"):
+            loss_scale = None
+        elif loss_scale != "dynamic":
+            loss_scale = float(loss_scale)
     state = amp.initialize(model.apply, sgd, opt_level=args.opt_level,
                            loss_scale=loss_scale)
     params = state.cast_params(params)
@@ -153,7 +156,10 @@ def main():
     if sgd is not None:
         opt_state = sgd.init(params)
     else:
-        opt_state = jax.tree_util.tree_map(jnp.zeros_like, params)
+        # f32 momentum regardless of param dtype (the update promotes to
+        # f32; a bf16 init would flip dtype after step 1 -> recompile)
+        opt_state = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
 
     params, bn_state, opt_state = jax.device_put(
         (params, bn_state, opt_state), replicated)

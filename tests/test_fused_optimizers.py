@@ -247,6 +247,51 @@ class TestFusedLAMB:
             assert float(jnp.max(jnp.abs(a - b))) < 1.0
 
 
+class TestFusedMixedPrecisionLamb:
+    """apex ``fused_mixed_precision_lamb.py``: LAMB over low-precision
+    model params with fp32 master copies (the BERT O2 recipe optimizer)."""
+
+    def test_master_copy_exists_and_tracks_fp32_lamb(self, rng):
+        from apex_tpu.optimizers import FusedLAMB, FusedMixedPrecisionLamb
+
+        params = make_params(rng, dtype=np.float32)
+        bf16_params = jax.tree_util.tree_map(
+            lambda p: p.astype(jnp.bfloat16), params)
+        opt = FusedMixedPrecisionLamb(lr=1e-2,
+                                      reduced_precision_dtype=jnp.bfloat16)
+        state = opt.init(bf16_params)
+        assert any("master" in b for b in state["buckets"].values())
+
+        ref_opt = FusedLAMB(lr=1e-2)
+        ref_state = ref_opt.init(params)
+        grads = make_grads(rng, bf16_params)
+        f32_grads = jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.float32), grads)
+        p, s, rp, rs = bf16_params, state, params, ref_state
+        for _ in range(3):
+            p, s = opt.step(grads, p, s)
+            rp, rs = ref_opt.step(f32_grads, rp, rs)
+        assert all(x.dtype == jnp.bfloat16
+                   for x in jax.tree_util.tree_leaves(p))
+        tree_allclose(p, rp, rtol=2e-2, atol=2e-2)
+
+    def test_noop_flag_freezes_master(self, rng):
+        from apex_tpu.optimizers import FusedMixedPrecisionLamb
+
+        params = make_params(rng, dtype=np.float32)
+        bf16_params = jax.tree_util.tree_map(
+            lambda p: p.astype(jnp.bfloat16), params)
+        opt = FusedMixedPrecisionLamb(lr=1e-2)
+        state = opt.init(bf16_params)
+        grads = make_grads(rng, bf16_params)
+        p1, s1 = opt.step(grads, bf16_params, state,
+                          noop_flag=jnp.ones((), jnp.int32))
+        for a, b in zip(jax.tree_util.tree_leaves(p1),
+                        jax.tree_util.tree_leaves(bf16_params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert int(s1["step"]) == 0
+
+
 class TestFusedNovoGradAdagrad:
     def test_novograd_first_step(self, rng):
         params = make_params(rng)
