@@ -1,0 +1,167 @@
+"""apex.RNN equivalent — DEPRECATED tier kept for surface parity
+(reference: ``apex/RNN/{models.py,RNNBackend.py,cells.py}``, fused
+pointwise RNN/LSTM/GRU cells; upstream marks the whole package
+deprecated and unmaintained).
+
+Functional TPU form: each factory returns a model object with
+``init_params(key)`` and ``apply(params, x, h0=None)`` where ``x`` is
+``(seq, batch, input)`` (the reference's default time-major layout).
+The recurrence is a ``lax.scan`` — the pointwise cell math fuses into
+one kernel per step under XLA, which is exactly what the reference's
+fused cells hand-wrote.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["LSTM", "GRU", "RNNTanh", "RNNReLU"]
+
+
+def _deprecated():
+    warnings.warn(
+        "apex_tpu.RNN is deprecated surface parity with apex.RNN; use "
+        "flax/optax recurrent layers for new code", DeprecationWarning,
+        stacklevel=3)
+
+
+def _linear_init(key, n_in, n_out):
+    k1, k2 = jax.random.split(key)
+    bound = n_in ** -0.5
+    return {"weight": jax.random.uniform(k1, (n_in, n_out), jnp.float32,
+                                         -bound, bound),
+            "bias": jax.random.uniform(k2, (n_out,), jnp.float32,
+                                       -bound, bound)}
+
+
+class _Recurrent:
+    """Shared scan driver over a per-step cell."""
+
+    n_gates = 1
+    n_state = 1          # 1: h only; 2: (h, c)
+
+    def __init__(self, input_size, hidden_size, num_layers=1, bias=True,
+                 dropout=0.0):
+        _deprecated()
+        if dropout:
+            warnings.warn("dropout ignored (parity-only kwarg)")
+        self.input_size = int(input_size)
+        self.hidden_size = int(hidden_size)
+        self.num_layers = int(num_layers)
+        self.bias = bool(bias)
+
+    def init_params(self, key):
+        out = []
+        for layer in range(self.num_layers):
+            k_i, k_h, key = jax.random.split(key, 3)
+            n_in = self.input_size if layer == 0 else self.hidden_size
+            lp = {
+                "i2h": _linear_init(k_i, n_in,
+                                    self.n_gates * self.hidden_size),
+                "h2h": _linear_init(k_h, self.hidden_size,
+                                    self.n_gates * self.hidden_size),
+            }
+            if not self.bias:
+                for lin in lp.values():
+                    del lin["bias"]
+            out.append(lp)
+        return out
+
+    @staticmethod
+    def _affine(lin, x):
+        y = x @ lin["weight"]
+        return y + lin["bias"] if "bias" in lin else y
+
+    def _cell(self, p, x_t, state):
+        raise NotImplementedError
+
+    def _zero_state(self, batch):
+        z = jnp.zeros((batch, self.hidden_size), jnp.float32)
+        return (z,) * self.n_state
+
+    def apply(self, params, x, h0=None):
+        """Returns ``(outputs (seq, batch, hidden), final_states)``.
+
+        ``h0``: optional initial states — a list with one state tuple per
+        layer, exactly the ``final_states`` a previous ``apply`` returned
+        (so resuming is ``m.apply(p, x2, h0=states)``).
+        """
+        batch = x.shape[1]
+        if h0 is not None and len(h0) != self.num_layers:
+            raise ValueError(
+                f"h0 must be a list of {self.num_layers} per-layer state "
+                "tuples (as returned in final_states)")
+        states = []
+        for layer, p in enumerate(params):
+            init = (self._zero_state(batch) if h0 is None
+                    else tuple(h0[layer]))
+
+            def step(state, x_t, p=p):
+                new = self._cell(p, x_t, state)
+                return new, new[0]
+
+            final, x = jax.lax.scan(step, init, x)
+            states.append(final)
+        return x, states
+
+    __call__ = apply
+
+
+class _LSTM(_Recurrent):
+    n_gates, n_state = 4, 2
+
+    def _cell(self, p, x_t, state):
+        h, c = state
+        g = self._affine(p["i2h"], x_t) + self._affine(p["h2h"], h)
+        i, f, gc, o = jnp.split(g, 4, axis=-1)
+        c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(gc)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return h, c
+
+
+class _GRU(_Recurrent):
+    n_gates = 3
+
+    def _cell(self, p, x_t, state):
+        (h,) = state
+        gi = self._affine(p["i2h"], x_t)
+        gh = self._affine(p["h2h"], h)
+        i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+        h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(i_r + h_r)
+        z = jax.nn.sigmoid(i_z + h_z)
+        n = jnp.tanh(i_n + r * h_n)
+        return ((1 - z) * n + z * h,)
+
+
+class _RNN(_Recurrent):
+    def __init__(self, *args, nonlinearity=jnp.tanh, **kw):
+        super().__init__(*args, **kw)
+        self.nonlinearity = nonlinearity
+
+    def _cell(self, p, x_t, state):
+        (h,) = state
+        return (self.nonlinearity(
+            self._affine(p["i2h"], x_t) + self._affine(p["h2h"], h)),)
+
+
+def LSTM(input_size, hidden_size, num_layers=1, **kw):
+    """Reference ``apex.RNN.models.LSTM`` factory."""
+    return _LSTM(input_size, hidden_size, num_layers, **kw)
+
+
+def GRU(input_size, hidden_size, num_layers=1, **kw):
+    return _GRU(input_size, hidden_size, num_layers, **kw)
+
+
+def RNNTanh(input_size, hidden_size, num_layers=1, **kw):
+    return _RNN(input_size, hidden_size, num_layers, nonlinearity=jnp.tanh,
+                **kw)
+
+
+def RNNReLU(input_size, hidden_size, num_layers=1, **kw):
+    return _RNN(input_size, hidden_size, num_layers,
+                nonlinearity=jax.nn.relu, **kw)

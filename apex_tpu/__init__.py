@@ -28,6 +28,7 @@ from apex_tpu._version import __version__
 import importlib as _importlib
 
 _SUBMODULES = (
+    "RNN",
     "amp",
     "contrib",
     "fp16_utils",

@@ -20,6 +20,12 @@ _SUBMODULES = (
     "transducer",
     "bottleneck",
     "peer_memory",
+    "conv_bias_relu",
+    "cudnn_gbn",
+    "nccl_p2p",
+    "nccl_allocator",
+    "gpu_direct_storage",
+    "openfold_triton",
 )
 
 

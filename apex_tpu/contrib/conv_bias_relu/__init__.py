@@ -1,0 +1,54 @@
+"""Fused Conv+Bias(+ReLU/+Mask) — TPU rebuild of
+``apex/contrib/conv_bias_relu/`` (``conv_bias_relu.py`` +
+``csrc/conv_bias_relu.cpp``, cudnn-frontend runtime-fused epilogues).
+
+The reference exposes four autograd functions over cudnn graph fusion:
+``ConvBiasReLU``, ``ConvBias``, ``ConvBiasMaskReLU``,
+``ConvFrozenScaleBiasReLU``.  On TPU each is a single jitted chain —
+XLA fuses conv+bias+relu epilogues into one kernel the same way the
+cudnn frontend runtime-fusion engine does, so the fusion IS the
+implementation; the functions exist so apex call sites port verbatim.
+Layout is NHWC (the reference requires channels_last).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["ConvBiasReLU", "ConvBias", "ConvBiasMaskReLU",
+           "ConvFrozenScaleBiasReLU"]
+
+_DN = ("NHWC", "HWIO", "NHWC")
+
+
+def _conv(x, w, stride, padding):
+    return jax.lax.conv_general_dilated(
+        x, w.astype(x.dtype), window_strides=(stride, stride),
+        padding=((padding, padding), (padding, padding)),
+        dimension_numbers=_DN)
+
+
+def ConvBias(x, weight, bias, padding=0, stride=1):
+    """conv + per-channel bias (reference ``ConvBias.apply``)."""
+    return _conv(x, weight, stride, padding) + bias.astype(x.dtype)
+
+
+def ConvBiasReLU(x, weight, bias, padding=0, stride=1):
+    """conv + bias + relu (reference ``ConvBiasReLU.apply``)."""
+    return jax.nn.relu(ConvBias(x, weight, bias, padding, stride))
+
+
+def ConvBiasMaskReLU(x, weight, bias, mask, padding=0, stride=1):
+    """conv + bias + elementwise mask + relu (reference
+    ``ConvBiasMaskReLU.apply``; the mask is the dropout/DropBlock mask
+    computed upstream)."""
+    y = ConvBias(x, weight, bias, padding, stride)
+    return jax.nn.relu(y * mask.astype(y.dtype))
+
+
+def ConvFrozenScaleBiasReLU(x, weight, scale, bias, padding=0, stride=1):
+    """conv + frozen-BN folded scale/bias + relu (reference
+    ``ConvFrozenScaleBiasReLU.apply``)."""
+    y = _conv(x, weight, stride, padding)
+    return jax.nn.relu(y * scale.astype(y.dtype) + bias.astype(y.dtype))

@@ -1,0 +1,31 @@
+"""Group batch norm, cudnn-frontend flavor — TPU rebuild of
+``apex/contrib/cudnn_gbn/`` (``batch_norm.py`` + ``norm_sample.cpp``).
+
+The reference's ``GroupBatchNorm2d`` is the same feature as
+``apex/contrib/groupbn`` — NHWC batch norm whose statistics are shared
+across a group of devices — implemented through cudnn's norm sampler
+instead of the hand-written kernels.  On TPU both reduce to one design
+(local Welford + psum over the group mesh axis), so this module provides
+the ``cudnn_gbn`` surface over :mod:`apex_tpu.contrib.groupbn`'s
+implementation; ``group_size`` maps to the size of the named mesh axis
+the call runs under.
+"""
+
+from __future__ import annotations
+
+from apex_tpu.contrib.groupbn import BatchNorm2d_NHWC
+
+__all__ = ["GroupBatchNorm2d"]
+
+
+class GroupBatchNorm2d(BatchNorm2d_NHWC):
+    """Reference ctor: ``GroupBatchNorm2d(num_features, group_size=1,
+    group_rank=..., fuse_relu=False)``; group membership here is the mesh
+    axis named by ``axis_name`` (group_size/rank come from the mesh)."""
+
+    def __init__(self, num_features, group_size=1, group_rank=None,
+                 bn_group=None, fuse_relu=False, axis_name=None, **kw):
+        del group_rank
+        group = bn_group if bn_group is not None else group_size
+        super().__init__(num_features, fuse_relu=fuse_relu,
+                         bn_group=group, axis_name=axis_name, **kw)

@@ -1,0 +1,48 @@
+"""NCCL user-buffer allocator surface — TPU rebuild of
+``apex/contrib/nccl_allocator/`` (``__init__.py`` +
+``NCCLAllocator.cpp``: a ``torch.cuda.MemPool`` whose allocations are
+``ncclCommRegister``-ed so collectives can use zero-copy user buffers).
+
+There is nothing to register on TPU: XLA owns all device buffers and its
+collectives already run zero-copy over ICI; the closest controllable
+analogue is buffer donation (``jax.jit(..., donate_argnums=...)``),
+which the framework's train steps use directly.  This module keeps the
+reference's API shape as documented no-ops so ported call sites run:
+
+    import apex_tpu.contrib.nccl_allocator as nccl_allocator
+    nccl_allocator.init()
+    with nccl_allocator.nccl_mem():
+        buffers = [jnp.zeros(...) for _ in range(8)]
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["init", "nccl_mem", "create_nccl_mem_pool"]
+
+_initialized = False
+
+
+def init() -> None:
+    """Reference ``nccl_allocator.init()``; no-op (nothing to hook)."""
+    global _initialized
+    _initialized = True
+
+
+def create_nccl_mem_pool(symmetric: bool = False):
+    """Reference returns a ``torch.cuda.MemPool``; here a token object."""
+    return object()
+
+
+@contextlib.contextmanager
+def nccl_mem(pool=None, enabled: bool = True, device=None, group=None):
+    """Reference context manager routing allocations into the registered
+    pool.  On TPU allocations inside the block are ordinary XLA buffers —
+    collectives are already zero-copy — so this only validates usage."""
+    if not _initialized:
+        raise RuntimeError(
+            "nccl_allocator.init() must be called before nccl_mem() "
+            "(apex parity)")
+    del pool, enabled, device, group
+    yield
