@@ -22,10 +22,11 @@ __all__ = ["LSTM", "GRU", "RNNTanh", "RNNReLU"]
 
 
 def _deprecated():
+    # stack: _deprecated -> __init__ -> factory fn -> USER (level 4)
     warnings.warn(
         "apex_tpu.RNN is deprecated surface parity with apex.RNN; use "
         "flax/optax recurrent layers for new code", DeprecationWarning,
-        stacklevel=3)
+        stacklevel=4)
 
 
 def _linear_init(key, n_in, n_out):

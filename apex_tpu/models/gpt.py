@@ -44,6 +44,8 @@ class GPTConfig:
     axis_name: Optional[str] = None            # "model" inside shard_map
     sequence_parallel: bool = False
     rotary: bool = True
+    context_axis: Optional[str] = None         # CP: sequence sharded here
+    context_mechanism: str = "ring"            # "ring" | "ulysses"
     remat: bool = False                        # jax.checkpoint each layer
     dtype: jnp.dtype = jnp.float32             # activation/compute dtype
     param_dtype: jnp.dtype = jnp.float32
@@ -58,6 +60,10 @@ class GPTConfig:
             raise ValueError(
                 "num_attention_heads must be divisible by "
                 "tensor_parallel_size")
+        if self.context_mechanism not in ("ring", "ulysses"):
+            raise ValueError(
+                f"context_mechanism must be 'ring' or 'ulysses', got "
+                f"{self.context_mechanism!r}")
 
     @property
     def head_dim(self):
@@ -111,7 +117,16 @@ class ParallelAttention:
         q = q.transpose(0, 2, 1, 3)
         k = k.transpose(0, 2, 1, 3)
         v = v.transpose(0, 2, 1, 3)
-        ctx = flash_attention(q, k, v, causal=True)
+        if cfg.context_axis is not None:
+            # context parallelism: s here is the LOCAL shard; attention
+            # runs over the global sequence (beyond-reference long-context)
+            from apex_tpu.transformer.context_parallel import (
+                ring_attention, ulysses_attention)
+            attn = (ring_attention if cfg.context_mechanism == "ring"
+                    else ulysses_attention)
+            ctx = attn(q, k, v, cfg.context_axis, causal=True)
+        else:
+            ctx = flash_attention(q, k, v, causal=True)
         ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, nh * cfg.head_dim)
         out, _ = self.proj(params["proj"], ctx)
         return out
@@ -210,14 +225,36 @@ class GPTModel:
         f = rope_freqs(seq_len, self.cfg.head_dim)
         return jnp.cos(f), jnp.sin(f)
 
+    def _seq_offset(self, local_len):
+        """Global position of this shard's first token (0 without CP)."""
+        if self.cfg.context_axis is None:
+            return 0
+        return jax.lax.axis_index(self.cfg.context_axis) * local_len
+
     def embed(self, params, tokens):
         x = self.embedding(params["embedding"], tokens)
         if not self.cfg.rotary:
-            x = x + params["position_embedding"][:tokens.shape[1]]
+            pe = jax.lax.dynamic_slice_in_dim(
+                params["position_embedding"],
+                self._seq_offset(tokens.shape[1]), tokens.shape[1])
+            x = x + pe
         return x.astype(self.cfg.dtype)
 
     def backbone(self, params, x, seq_len=None):
-        cos, sin = self.rope_tables(seq_len or x.shape[1])
+        local = seq_len or x.shape[1]
+        if self.cfg.context_axis is not None:
+            # rope positions are GLOBAL: build full tables, take the shard
+            n_ctx = jax.lax.axis_size(self.cfg.context_axis)
+            cos, sin = self.rope_tables(local * n_ctx)
+            if cos is not None:
+                off = self._seq_offset(local)
+                cos = jax.lax.dynamic_slice_in_dim(cos, off, local)
+                sin = jax.lax.dynamic_slice_in_dim(sin, off, local)
+            return self._backbone_layers(params, x, cos, sin)
+        cos, sin = self.rope_tables(local)
+        return self._backbone_layers(params, x, cos, sin)
+
+    def _backbone_layers(self, params, x, cos, sin):
         for layer, lp in zip(self.layers, params["layers"]):
             if self.cfg.remat:
                 # trade recompute for activation memory (apex
@@ -244,13 +281,20 @@ class GPTModel:
     apply = __call__
 
     def loss(self, params, tokens, targets):
-        """Mean next-token loss via vocab-parallel cross entropy."""
+        """Mean next-token loss via vocab-parallel cross entropy.
+
+        Under context parallelism the mean over local tokens is pmeaned
+        across the context axis (equal shard sizes -> exact global mean).
+        """
         logits = self(params, tokens)
         b, s, vl = logits.shape
         per = tp.vocab_parallel_cross_entropy(
             logits.reshape(b * s, vl), targets.reshape(b * s),
             axis_name=self.cfg.axis_name)
-        return jnp.mean(per)
+        mean = jnp.mean(per)
+        if self.cfg.context_axis is not None:
+            mean = jax.lax.pmean(mean, self.cfg.context_axis)
+        return mean
 
     # -- GSPMD form ---------------------------------------------------------
 

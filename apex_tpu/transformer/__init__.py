@@ -4,6 +4,7 @@ mesh (reference: ``apex/transformer/__init__.py``)."""
 from apex_tpu.transformer import parallel_state
 from apex_tpu.transformer import tensor_parallel
 from apex_tpu.transformer import pipeline_parallel
+from apex_tpu.transformer import context_parallel
 from apex_tpu.transformer.microbatches import (
     build_num_microbatches_calculator,
     ConstantNumMicroBatches,
@@ -16,6 +17,7 @@ __all__ = [
     "parallel_state",
     "tensor_parallel",
     "pipeline_parallel",
+    "context_parallel",
     "build_num_microbatches_calculator",
     "ConstantNumMicroBatches",
     "RampupBatchsizeNumMicroBatches",
