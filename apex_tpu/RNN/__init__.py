@@ -22,11 +22,13 @@ __all__ = ["LSTM", "GRU", "RNNTanh", "RNNReLU"]
 
 
 def _deprecated():
-    # stack: _deprecated -> __init__ -> factory fn -> USER (level 4)
+    # called from the public factory functions: _deprecated(1) ->
+    # factory(2) -> USER(3); constructors don't warn, so the level is
+    # the same for every entry point
     warnings.warn(
         "apex_tpu.RNN is deprecated surface parity with apex.RNN; use "
         "flax/optax recurrent layers for new code", DeprecationWarning,
-        stacklevel=4)
+        stacklevel=3)
 
 
 def _linear_init(key, n_in, n_out):
@@ -46,7 +48,6 @@ class _Recurrent:
 
     def __init__(self, input_size, hidden_size, num_layers=1, bias=True,
                  dropout=0.0):
-        _deprecated()
         if dropout:
             warnings.warn("dropout ignored (parity-only kwarg)")
         self.input_size = int(input_size)
@@ -151,18 +152,22 @@ class _RNN(_Recurrent):
 
 def LSTM(input_size, hidden_size, num_layers=1, **kw):
     """Reference ``apex.RNN.models.LSTM`` factory."""
+    _deprecated()
     return _LSTM(input_size, hidden_size, num_layers, **kw)
 
 
 def GRU(input_size, hidden_size, num_layers=1, **kw):
+    _deprecated()
     return _GRU(input_size, hidden_size, num_layers, **kw)
 
 
 def RNNTanh(input_size, hidden_size, num_layers=1, **kw):
+    _deprecated()
     return _RNN(input_size, hidden_size, num_layers, nonlinearity=jnp.tanh,
                 **kw)
 
 
 def RNNReLU(input_size, hidden_size, num_layers=1, **kw):
+    _deprecated()
     return _RNN(input_size, hidden_size, num_layers,
                 nonlinearity=jax.nn.relu, **kw)

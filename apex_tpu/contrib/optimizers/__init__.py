@@ -37,7 +37,22 @@ from apex_tpu.optimizers.base import _f32
 from apex_tpu.optimizers.fused_adam import FusedAdam
 from apex_tpu.optimizers.fused_lamb import FusedLAMB
 
-__all__ = ["DistributedFusedAdam", "DistributedFusedLAMB"]
+__all__ = ["DistributedFusedAdam", "DistributedFusedLAMB",
+           "FusedAdam", "FusedLamb", "FP16_Optimizer"]
+
+# Deprecated tier parity: apex/contrib/optimizers also carries the OLD
+# contrib FusedAdam/FusedLAMB/FP16_Optimizer (pre-apex.optimizers
+# lineage, deprecated upstream).  Re-exported from their living homes so
+# recipes importing the contrib paths run.
+FusedLamb = FusedLAMB                       # the contrib-era spelling
+
+
+def __getattr__(name):
+    if name == "FP16_Optimizer":
+        from apex_tpu.fp16_utils import FP16_Optimizer
+        return FP16_Optimizer
+    raise AttributeError(
+        f"module 'apex_tpu.contrib.optimizers' has no attribute {name!r}")
 
 
 class _DistributedMixin:

@@ -178,11 +178,22 @@ class DistributedDataParallel:
                                    average=self.gradient_average)
 
     @staticmethod
-    def accumulate(acc, grads):
-        """Microbatch gradient accumulation (``delay_allreduce`` interior)."""
+    def accumulate(acc, grads, main_grad_dtype=None):
+        """Microbatch gradient accumulation (``delay_allreduce`` interior).
+
+        ``main_grad_dtype=jnp.float32`` reproduces apex's
+        ``gradient_accumulation_fusion`` / ``main_grad`` contract: each
+        microbatch's (possibly bf16) grads are accumulated into an fp32
+        buffer (reference ``fused_weight_gradient_mlp_cuda`` accumulates
+        the wgrad GEMM into ``weight.main_grad`` in fp32).
+        """
+        def cast(g):
+            return g if main_grad_dtype is None else \
+                g.astype(main_grad_dtype)
         if acc is None:
-            return grads
-        return jax.tree_util.tree_map(jnp.add, acc, grads)
+            return jax.tree_util.tree_map(cast, grads)
+        return jax.tree_util.tree_map(
+            lambda a, g: a + cast(g), acc, grads)
 
 
 class Reducer:

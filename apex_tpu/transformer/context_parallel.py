@@ -34,8 +34,6 @@ contiguously over ``axis_name`` (rank r holds rows
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 

@@ -52,8 +52,17 @@ def linear_with_grad_accumulation_and_async_allreduce(
         axis_name: Optional[str] = TENSOR_AXIS):
     """Column-parallel matmul with the apex collective pairing.
 
-    ``async_grad_allreduce``/``gradient_accumulation_fusion`` are accepted
-    for parity — overlap and accumulation fusion are compiler-scheduled.
+    ``async_grad_allreduce`` is parity-only: the input-grad allreduce /
+    wgrad-GEMM overlap it requests is the XLA latency-hiding scheduler's
+    job here.  ``gradient_accumulation_fusion`` (apex: wgrad GEMM
+    accumulating directly into an fp32 ``weight.main_grad``,
+    ``fused_weight_gradient_mlp_cuda``) decomposes functionally: JAX
+    cotangents must match the weight dtype, so the fp32 accumulation
+    lives one level up — microbatch loops accumulate with
+    ``apex_tpu.parallel.DistributedDataParallel.accumulate(...,
+    main_grad_dtype=jnp.float32)`` and the optimizer applies them via its
+    fp32 master path (``master_weights=True``).  Same arithmetic as the
+    reference: per-microbatch bf16 wgrads summed in fp32.
     """
     del gradient_accumulation_fusion, async_grad_allreduce
     if axis_name is not None:

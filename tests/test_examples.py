@@ -59,6 +59,14 @@ class TestExamples:
              "--print-freq", "1", "--ngf", "8", "--ndf", "8",
              "--nz", "16"]))
 
+    def test_conformer_rnnt(self):
+        _check(_run_example(
+            "examples/conformer/train_rnnt.py",
+            ["--steps", "2", "--print-freq", "1", "--batch-size", "2",
+             "--layers", "1", "--hidden", "32", "--heads", "2",
+             "--audio-len", "40", "--target-len", "6", "--vocab", "16",
+             "--pred-hidden", "32", "--n-mels", "8"]))
+
     @pytest.mark.parametrize("opt_level", ["O0", "O2"])
     def test_bert_pretrain(self, opt_level):
         out = _check(_run_example(

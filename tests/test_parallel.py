@@ -267,3 +267,38 @@ class TestLARCAndClipGrad:
         clipped, norm = clip_grad_norm_(grads, max_norm=10.0)
         np.testing.assert_allclose(np.asarray(clipped["a"]),
                                    np.asarray(grads["a"]), rtol=1e-6)
+
+
+class TestMainGradAccumulation:
+    """apex gradient_accumulation_fusion / main_grad contract: microbatch
+    grads accumulate in fp32 regardless of model dtype
+    (reference fused_weight_gradient_mlp_cuda)."""
+
+    def test_accumulate_fp32_main_grad(self):
+        g_bf16 = {"w": jnp.full((4,), 0.1, jnp.bfloat16)}
+        acc = DistributedDataParallel.accumulate(
+            None, g_bf16, main_grad_dtype=jnp.float32)
+        assert acc["w"].dtype == jnp.float32
+        for _ in range(63):
+            acc = DistributedDataParallel.accumulate(
+                acc, g_bf16, main_grad_dtype=jnp.float32)
+        # 64 accumulations of bf16(0.1): fp32 accumulation keeps the sum
+        # accurate to bf16(0.1)*64, bf16 accumulation would have drifted
+        expect = 64 * float(jnp.bfloat16(0.1))
+        np.testing.assert_allclose(np.asarray(acc["w"]), expect,
+                                   rtol=1e-6)
+
+    def test_accumulate_default_keeps_dtype(self):
+        g = {"w": jnp.ones((4,), jnp.bfloat16)}
+        acc = DistributedDataParallel.accumulate(None, g)
+        assert acc["w"].dtype == jnp.bfloat16
+
+
+class TestContribOptimizerShims:
+    def test_deprecated_reexports(self):
+        from apex_tpu.contrib import optimizers as co
+        from apex_tpu.fp16_utils import FP16_Optimizer
+        from apex_tpu.optimizers import FusedAdam, FusedLAMB
+        assert co.FusedAdam is FusedAdam
+        assert co.FusedLamb is FusedLAMB
+        assert co.FP16_Optimizer is FP16_Optimizer
