@@ -60,9 +60,7 @@ def main():
     from apex_tpu.contrib.transducer import TransducerJoint, TransducerLoss
     from apex_tpu.normalization import FusedLayerNorm
     from apex_tpu.optimizers import FusedNovoGrad
-    with warnings.catch_warnings():
-        warnings.simplefilter("ignore", DeprecationWarning)
-        from apex_tpu.RNN import LSTM
+    from apex_tpu.RNN import LSTM
 
     H, nh, L = args.hidden, args.heads, args.layers
     key = jax.random.PRNGKey(args.seed)

@@ -59,6 +59,15 @@ class TestExamples:
              "--print-freq", "1", "--ngf", "8", "--ndf", "8",
              "--nz", "16"]))
 
+    @pytest.mark.parametrize("mechanism", ["ring", "ulysses"])
+    def test_long_context(self, mechanism):
+        out = _check(_run_example(
+            "examples/long_context/train_long_gpt.py",
+            ["--seq-len", "64", "--hidden", "32", "--layers", "1",
+             "--heads", "8", "--vocab", "64", "--steps", "2",
+             "--print-freq", "1", "--mechanism", mechanism]))
+        assert "devices=8" in out
+
     def test_conformer_rnnt(self):
         _check(_run_example(
             "examples/conformer/train_rnnt.py",

@@ -1,0 +1,114 @@
+"""fp16_utils surface (reference: ``apex/fp16_utils/{fp16util,
+loss_scaler,fp16_optimizer}.py`` — the pre-amp manual mixed-precision
+tier, tested upstream in ``tests/L0/run_fp16util``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.fp16_utils import (BN_convert_float, DynamicLossScaler,
+                                 FP16_Optimizer, master_params_to_model_params,
+                                 model_grads_to_master_grads,
+                                 network_to_half, prep_param_lists)
+from apex_tpu.optimizers import FusedAdam
+
+
+@pytest.fixture
+def params():
+    rng = np.random.RandomState(0)
+    return {
+        "linear": {"weight": jnp.asarray(rng.randn(8, 8), jnp.float32),
+                   "bias": jnp.zeros((8,), jnp.float32)},
+        "bn": {"weight": jnp.ones((8,), jnp.float32),
+               "bias": jnp.zeros((8,), jnp.float32)},
+        "step": jnp.zeros((), jnp.int32),
+    }
+
+
+class TestFp16Util:
+    def test_network_to_half_keeps_norm_fp32(self, params):
+        half = network_to_half(params)
+        assert half["linear"]["weight"].dtype == jnp.bfloat16
+        assert half["bn"]["weight"].dtype == jnp.float32      # BN stays
+        assert half["step"].dtype == jnp.int32                # non-float
+
+    def test_bn_convert_float(self, params):
+        all_half = jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
+        fixed = BN_convert_float(all_half)
+        assert fixed["bn"]["weight"].dtype == jnp.float32
+        assert fixed["linear"]["weight"].dtype == jnp.bfloat16
+
+    def test_prep_and_sync_roundtrip(self, params):
+        half = network_to_half(params)
+        model_p, master_p = prep_param_lists(half)
+        assert master_p["linear"]["weight"].dtype == jnp.float32
+        # perturb master, sync down, dtypes follow the model pytree
+        master_p = jax.tree_util.tree_map(
+            lambda x: x + 1 if jnp.issubdtype(x.dtype, jnp.floating)
+            else x, master_p)
+        synced = master_params_to_model_params(model_p, master_p)
+        assert synced["linear"]["weight"].dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(synced["bn"]["weight"]),
+            np.asarray(params["bn"]["weight"]) + 1)
+
+    def test_model_grads_to_master_grads(self, params):
+        g = jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x,
+            {"linear": params["linear"]})
+        mg = model_grads_to_master_grads(g)
+        assert mg["linear"]["weight"].dtype == jnp.float32
+
+
+class TestFP16Optimizer:
+    def _tiny(self):
+        rng = np.random.RandomState(1)
+        params = {"w": jnp.asarray(rng.randn(16, 16) * 0.1, jnp.bfloat16)}
+        grads = {"w": jnp.asarray(rng.randn(16, 16) * 0.01, jnp.bfloat16)}
+        return params, grads
+
+    def test_step_matches_fp32_adam(self):
+        params, grads = self._tiny()
+        opt = FP16_Optimizer(FusedAdam(lr=1e-2))
+        state = opt.init(params)
+        p = params
+        for _ in range(3):
+            p, state = opt.step(grads, p, state)
+        assert p["w"].dtype == jnp.bfloat16
+
+        ref_opt = FusedAdam(lr=1e-2)
+        rp = {"w": params["w"].astype(jnp.float32)}
+        rs = ref_opt.init(rp)
+        rg = {"w": grads["w"].astype(jnp.float32)}
+        for _ in range(3):
+            rp, rs = ref_opt.step(rg, rp, rs)
+        np.testing.assert_allclose(np.asarray(p["w"], np.float32),
+                                   np.asarray(rp["w"]),
+                                   rtol=2e-2, atol=2e-2)
+
+    def test_scaled_loss_and_overflow_skip(self):
+        params, grads = self._tiny()
+        opt = FP16_Optimizer(FusedAdam(lr=1e-2), dynamic_loss_scale=True,
+                             dynamic_loss_args={"init_scale": 2.0 ** 8})
+        state = opt.init(params)
+        loss = opt.scale_loss(jnp.float32(2.0), state)
+        assert float(loss) == 2.0 * 2.0 ** 8
+
+        inf_grads = {"w": jnp.full_like(grads["w"], jnp.inf)}
+        p1, s1 = opt.step(inf_grads, params, state)
+        # overflow: params unchanged, scale halved
+        np.testing.assert_array_equal(
+            np.asarray(p1["w"], np.float32),
+            np.asarray(params["w"], np.float32))
+        assert float(s1["loss_scaler"].loss_scale) < 2.0 ** 8
+
+    def test_dynamic_loss_scaler_alias(self):
+        s = DynamicLossScaler(init_scale=2.0 ** 10)
+        st = s.init()
+        assert float(st.loss_scale) == 2.0 ** 10
+        st2 = s.update(st, jnp.float32(1.0))     # overflow -> backoff
+        assert float(st2.loss_scale) < 2.0 ** 10
