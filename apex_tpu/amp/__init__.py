@@ -4,12 +4,20 @@ from apex_tpu.amp.interpreter import autocast
 from apex_tpu.amp.scaler import LossScaler, LossScaleState
 from apex_tpu.amp.lists import WHITELIST, BLACKLIST, PROMOTE
 
+
+def master_params(optimizer, params, opt_state):
+    """fp32 master copies held by a fused optimizer (apex
+    ``amp.master_params(optimizer)``; the functional form needs the param
+    pytree and optimizer state explicitly)."""
+    return optimizer.master_params(params, opt_state)
+
 __all__ = [
     "AmpState",
     "Properties",
     "initialize",
     "scale_loss",
     "unscale_step",
+    "master_params",
     "autocast",
     "LossScaler",
     "LossScaleState",

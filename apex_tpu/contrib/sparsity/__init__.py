@@ -1,6 +1,6 @@
 """ASP (automatic structured sparsity) — TPU rebuild of
-``apex/contrib/sparsity/`` (``asp.py``, ``sparse_masklib.py``; the CUDA
-permutation-search kernels are an accuracy refinement, not ported).
+``apex/contrib/sparsity/`` (``asp.py``, ``sparse_masklib.py``,
+``permutation_lib.py`` + its CUDA search kernels).
 
 The reference finds 2:4 magnitude masks for prunable weights, masks
 them, and re-applies the masks after every optimizer step (the optimizer
@@ -21,7 +21,9 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["create_mask", "ASP"]
+__all__ = ["create_mask", "ASP", "permutation_search",
+           "apply_input_permutation", "invert_permutation",
+           "magnitude_retained"]
 
 
 def create_mask(tensor, pattern="m4n2_1d"):
@@ -84,3 +86,99 @@ class ASP:
             return self.apply_masks(new_params, masks), new_state
 
         return wrapped
+
+
+# -- permutation search (reference: apex permutation_lib.py) ----------------
+
+def magnitude_retained(weight) -> float:
+    """Fraction of |weight| magnitude a 2:4 mask keeps (the permutation
+    search objective — reference ``permutation_lib``'s efficacy metric)."""
+    import numpy as np
+
+    w = np.abs(np.asarray(weight, np.float32))
+    total = float(w.sum())
+    if total == 0.0:
+        return 1.0
+    g = w.reshape(w.shape[0], -1, 4)
+    kept = np.sort(g, axis=-1)[..., 2:].sum()
+    return float(kept) / total
+
+
+def permutation_search(weight, max_passes: int = 4, seed: int = 0):
+    """Find an input-channel permutation improving 2:4 retained magnitude.
+
+    Reference ``permutation_lib.py`` searches channel permutations with
+    CUDA kernels so that magnitude pruning destroys less signal; this is
+    the host-side equivalent: bounded greedy column-swap passes (accept
+    any swap between different groups-of-4 that increases the kept
+    magnitude), deterministic for a given seed.
+
+    Returns ``(perm, improved_retained)`` where ``perm`` is an index
+    array with ``weight[:, perm]`` the permuted matrix.  Offline tool —
+    numpy, not jit; run once before training like the reference.
+    """
+    import numpy as np
+
+    w = np.abs(np.asarray(weight, np.float32))
+    n_out, n_in = w.shape
+    if n_in % 4:
+        raise ValueError("input dim must be divisible by 4")
+    perm = np.arange(n_in)
+    rng = np.random.RandomState(seed)
+
+    def group_kept(cols):
+        # kept magnitude of each group given column set (n_out, 4)
+        g = w[:, cols]
+        return np.sort(g, axis=-1)[:, 2:].sum()
+
+    groups = perm.reshape(-1, 4).copy()
+    kept = np.array([group_kept(g) for g in groups])
+    n_groups = len(groups)
+    for _ in range(max_passes):
+        improved = False
+        # bounded candidate sampling keeps this O(passes * n_in) instead
+        # of O(n_in^2) full pairwise search
+        order = rng.permutation(n_groups)
+        for gi in order:
+            gj = int(rng.randint(n_groups))
+            if gi == gj:
+                continue
+            base = kept[gi] + kept[gj]
+            best = (None, 0.0)
+            for a in range(4):
+                for b in range(4):
+                    groups[gi][a], groups[gj][b] = \
+                        groups[gj][b], groups[gi][a]
+                    trial = group_kept(groups[gi]) + group_kept(groups[gj])
+                    gain = trial - base
+                    if gain > best[1] + 1e-9:
+                        best = ((a, b), gain)
+                    groups[gi][a], groups[gj][b] = \
+                        groups[gj][b], groups[gi][a]
+            if best[0] is not None:
+                a, b = best[0]
+                groups[gi][a], groups[gj][b] = groups[gj][b], groups[gi][a]
+                kept[gi] = group_kept(groups[gi])
+                kept[gj] = group_kept(groups[gj])
+                improved = True
+        if not improved:
+            break
+    perm = groups.reshape(-1)
+    return perm, float(kept.sum()) / max(float(w.sum()), 1e-30)
+
+
+def apply_input_permutation(weight, perm):
+    """``weight[:, perm]`` — permute input channels before masking.  The
+    consuming layer's INPUT must be permuted identically (or the
+    producing layer's output channels — reference propagates through the
+    model graph; here the caller owns that wiring)."""
+    return weight[:, jnp.asarray(perm)]
+
+
+def invert_permutation(perm):
+    import numpy as np
+
+    perm = np.asarray(perm)
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(len(perm))
+    return inv

@@ -115,6 +115,24 @@ class FusedOptimizer:
             buckets[info.key] = st
         return {"step": jnp.zeros((), jnp.int32), "buckets": buckets}
 
+    def master_params(self, params, state):
+        """fp32 master copies as a pytree shaped like ``params`` (apex
+        ``amp.master_params(optimizer)``).  Buckets without a master copy
+        (already-fp32 params) return the params upcast as-is."""
+        layout = self._layout(params)
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        out = [l.astype(_f32) if jnp.issubdtype(l.dtype, jnp.floating)
+               else l for l in leaves]
+        for info in layout.buckets:
+            bucket_state = state["buckets"][info.key]
+            if "master" not in bucket_state:
+                continue
+            masters = B.unflatten_bucket(
+                bucket_state["master"], info.meta._replace(dtype=_f32))
+            for i, t in zip(info.indices, masters):
+                out[i] = t
+        return jax.tree_util.tree_unflatten(treedef, out)
+
     # -- step --------------------------------------------------------------
 
     def step(self, grads, params, state, *, lr=None, grad_scale=1.0,

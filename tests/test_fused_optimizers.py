@@ -321,3 +321,36 @@ class TestFusedNovoGradAdagrad:
             upd, ref_state = ref.update(grads, ref_state)
             ref_params = optax.apply_updates(ref_params, upd)
             tree_allclose(params, ref_params, rtol=1e-4, atol=1e-6)
+
+
+class TestMasterParams:
+    """apex amp.master_params: extract the fp32 master copies."""
+
+    def test_masters_match_fp32_trajectory(self, rng):
+        from apex_tpu import amp
+
+        params = make_params(rng, dtype=np.float32)
+        bf16 = jax.tree_util.tree_map(
+            lambda p: p.astype(jnp.bfloat16), params)
+        opt = FusedAdam(lr=1e-3, master_weights=True)
+        state = opt.init(bf16)
+        grads = make_grads(rng, bf16)
+        p, s = opt.step(grads, bf16, state)
+        masters = amp.master_params(opt, p, s)
+        for m, mp in zip(jax.tree_util.tree_leaves(masters),
+                         jax.tree_util.tree_leaves(p)):
+            assert m.dtype == jnp.float32
+            # model params are the bf16 round-trip of the masters
+            np.testing.assert_array_equal(
+                np.asarray(m.astype(jnp.bfloat16)), np.asarray(mp))
+
+    def test_fp32_params_pass_through(self, rng):
+        from apex_tpu import amp
+
+        params = make_params(rng, dtype=np.float32)
+        opt = FusedAdam(lr=1e-3)
+        state = opt.init(params)
+        masters = amp.master_params(opt, params, state)
+        for m, p in zip(jax.tree_util.tree_leaves(masters),
+                        jax.tree_util.tree_leaves(params)):
+            np.testing.assert_array_equal(np.asarray(m), np.asarray(p))
