@@ -93,26 +93,12 @@ class _DistributedMixin:
             buckets[info.key] = st
         return {"step": jnp.zeros((), jnp.int32), "buckets": buckets}
 
-    def master_params(self, params, state):
-        """fp32 masters from the ROW-SHARDED buckets (call inside
-        ``shard_map``, like ``step``): each master bucket is this
-        device's shard, so it is all-gathered before unflattening — the
-        inherited unsharded unflatten would silently slice garbage."""
-        layout = self._layout(params)
-        leaves, treedef = jax.tree_util.tree_flatten(params)
-        out = [l.astype(_f32) if jnp.issubdtype(l.dtype, jnp.floating)
-               else l for l in leaves]
-        for info in layout.buckets:
-            bucket_state = state["buckets"][info.key]
-            if "master" not in bucket_state:
-                continue
-            full = jax.lax.all_gather(bucket_state["master"],
-                                      self.axis_name, axis=0, tiled=True)
-            masters = B.unflatten_bucket(
-                full, info.meta._replace(dtype=_f32))
-            for i, t in zip(info.indices, masters):
-                out[i] = t
-        return jax.tree_util.tree_unflatten(treedef, out)
+    def _full_master_bucket(self, packed_master):
+        # master buckets are ROW SHARDS here; all-gather to the full
+        # rows before the base class unflattens (call master_params
+        # inside shard_map, like step)
+        return jax.lax.all_gather(packed_master, self.axis_name, axis=0,
+                                  tiled=True)
 
     def state_specs(self, params):
         """PartitionSpec pytree for ``shard_map`` out/in_specs: moment and

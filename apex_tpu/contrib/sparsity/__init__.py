@@ -96,10 +96,13 @@ def magnitude_retained(weight) -> float:
     import numpy as np
 
     w = np.abs(np.asarray(weight, np.float32))
+    if w.shape[-1] % 4:
+        raise ValueError("last dim must be divisible by 4 (m4n2_1d "
+                         "groups, matching create_mask)")
     total = float(w.sum())
     if total == 0.0:
         return 1.0
-    g = w.reshape(w.shape[0], -1, 4)
+    g = w.reshape(*w.shape[:-1], -1, 4)
     kept = np.sort(g, axis=-1)[..., 2:].sum()
     return float(kept) / total
 

@@ -115,6 +115,11 @@ class FusedOptimizer:
             buckets[info.key] = st
         return {"step": jnp.zeros((), jnp.int32), "buckets": buckets}
 
+    def _full_master_bucket(self, packed_master):
+        """The bucket's FULL packed master rows (hook: the ZeRO mixin
+        stores row shards and all-gathers here)."""
+        return packed_master
+
     def master_params(self, params, state):
         """fp32 master copies as a pytree shaped like ``params`` (apex
         ``amp.master_params(optimizer)``).  Buckets without a master copy
@@ -128,7 +133,8 @@ class FusedOptimizer:
             if "master" not in bucket_state:
                 continue
             masters = B.unflatten_bucket(
-                bucket_state["master"], info.meta._replace(dtype=_f32))
+                self._full_master_bucket(bucket_state["master"]),
+                info.meta._replace(dtype=_f32))
             for i, t in zip(info.indices, masters):
                 out[i] = t
         return jax.tree_util.tree_unflatten(treedef, out)

@@ -5,6 +5,7 @@ from apex_tpu.transformer import parallel_state
 from apex_tpu.transformer import tensor_parallel
 from apex_tpu.transformer import pipeline_parallel
 from apex_tpu.transformer import context_parallel
+from apex_tpu.transformer import expert_parallel
 from apex_tpu.transformer.microbatches import (
     build_num_microbatches_calculator,
     ConstantNumMicroBatches,
@@ -18,6 +19,7 @@ __all__ = [
     "tensor_parallel",
     "pipeline_parallel",
     "context_parallel",
+    "expert_parallel",
     "build_num_microbatches_calculator",
     "ConstantNumMicroBatches",
     "RampupBatchsizeNumMicroBatches",

@@ -1,0 +1,140 @@
+"""Expert parallelism: Switch-style MoE FFN over an ``expert`` mesh axis
+— BEYOND-REFERENCE (SURVEY §2.3: MoE/expert parallelism is NOT in apex;
+it lives in Megatron-LM proper.  Built here because EP is a first-class
+sharding axis for a complete TPU framework).
+
+Design (the standard TPU MoE dataflow, cf. Switch Transformer / GShard):
+every device holds ``n_experts / ep`` expert FFNs and a shard of the
+token batch.  Per device: top-1 gate → capacity-bounded dispatch into an
+``(n_experts, capacity, hidden)`` buffer → ``all_to_all`` over the
+expert axis (tokens travel to the device owning their expert) → batched
+expert FFN (one einsum over the local expert stack — MXU-friendly, no
+ragged loops) → inverse ``all_to_all`` → weighted combine.  Tokens over
+capacity are dropped (contribute zero), exactly like the references.
+
+``axis_name=None`` runs the identical math single-device (the serial
+golden for tests).  The auxiliary output is the Switch load-balancing
+loss (mean fraction·probability product, scaled by ``n_experts``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["MoEConfig", "MoEMLP"]
+
+_f32 = jnp.float32
+
+
+@dataclasses.dataclass
+class MoEConfig:
+    hidden_size: int
+    ffn_hidden_size: int
+    n_experts: int
+    capacity_factor: float = 1.25
+    expert_parallel_size: int = 1
+    axis_name: Optional[str] = None          # "expert" inside shard_map
+    param_dtype: jnp.dtype = jnp.float32
+
+    def __post_init__(self):
+        if self.n_experts % self.expert_parallel_size:
+            raise ValueError("n_experts must be divisible by "
+                             "expert_parallel_size")
+
+    @property
+    def local_experts(self):
+        return self.n_experts // self.expert_parallel_size
+
+
+class MoEMLP:
+    """Top-1 (Switch) MoE FFN.
+
+    ``params = m.init_params(key)`` holds THIS DEVICE's expert stack
+    (``(local_experts, ...)`` leaves) plus the replicated gate;
+    ``out, aux_loss = m(params, x)`` with ``x (tokens, hidden)`` local.
+    """
+
+    def __init__(self, cfg: MoEConfig):
+        self.cfg = cfg
+
+    def init_params(self, key):
+        cfg = self.cfg
+        k1, k2, k3 = jax.random.split(key, 3)
+        e, h, f = cfg.local_experts, cfg.hidden_size, cfg.ffn_hidden_size
+        return {
+            "gate": 0.02 * jax.random.normal(
+                k1, (h, cfg.n_experts), cfg.param_dtype),
+            "w1": (h ** -0.5) * jax.random.normal(
+                k2, (e, h, f), cfg.param_dtype),
+            "w2": (f ** -0.5) * jax.random.normal(
+                k3, (e, f, h), cfg.param_dtype),
+        }
+
+    def _capacity(self, n_tokens: int) -> int:
+        cfg = self.cfg
+        cap = int(cfg.capacity_factor * n_tokens / cfg.n_experts)
+        return max(cap, 1)
+
+    def __call__(self, params, x):
+        cfg = self.cfg
+        ep = cfg.expert_parallel_size
+        t, h = x.shape
+        ne, nl = cfg.n_experts, cfg.local_experts
+        cap = self._capacity(t)
+
+        xf = x.astype(_f32)
+        logits = xf @ params["gate"].astype(_f32)          # (T, E)
+        probs = jax.nn.softmax(logits, axis=-1)
+        expert_idx = jnp.argmax(probs, axis=-1)            # (T,)
+        gate_prob = jnp.take_along_axis(
+            probs, expert_idx[:, None], axis=-1)[:, 0]     # (T,)
+
+        # Switch aux loss: n_e * mean_e(fraction_e * mean_prob_e)
+        onehot = jax.nn.one_hot(expert_idx, ne, dtype=_f32)
+        fraction = jnp.mean(onehot, axis=0)
+        mean_prob = jnp.mean(probs, axis=0)
+        aux_loss = ne * jnp.sum(fraction * mean_prob)
+
+        # deterministic capacity: token's slot = its arrival order within
+        # its expert; tokens past `cap` are dropped (zero output)
+        pos = (jnp.cumsum(onehot, axis=0) * onehot).astype(jnp.int32)
+        pos_tok = jnp.max(pos, axis=-1) - 1                # (T,)
+        keep = (pos_tok < cap) & (pos_tok >= 0)
+        slot = jnp.clip(pos_tok, 0, cap - 1)
+
+        # dispatch: (E, cap, H) buffer; dropped tokens scatter nothing
+        buf = jnp.zeros((ne, cap, h), _f32)
+        buf = buf.at[expert_idx, slot].add(
+            xf * keep[:, None], mode="drop")
+
+        if cfg.axis_name is not None and ep > 1:
+            # (ep, nl, cap, H): chunk e goes to the device owning expert
+            # group e; received chunks stack on axis 0 as SOURCE device
+            buf = buf.reshape(ep, nl, cap, h)
+            buf = jax.lax.all_to_all(buf, cfg.axis_name, split_axis=0,
+                                     concat_axis=0, tiled=False)
+            # (ep_src, nl, cap, H) -> per local expert, all sources' slots
+            expert_in = buf.transpose(1, 0, 2, 3).reshape(nl, ep * cap, h)
+        else:
+            expert_in = buf                                # (E, cap, H)
+
+        # batched expert FFN: one einsum over the local expert stack
+        h1 = jnp.maximum(jnp.einsum(
+            "ech,ehf->ecf", expert_in, params["w1"].astype(_f32)), 0.0)
+        out_e = jnp.einsum("ecf,efh->ech", h1,
+                           params["w2"].astype(_f32))
+
+        if cfg.axis_name is not None and ep > 1:
+            out_e = out_e.reshape(nl, ep, cap, h).transpose(1, 0, 2, 3)
+            out_e = jax.lax.all_to_all(out_e, cfg.axis_name, split_axis=0,
+                                       concat_axis=0, tiled=False)
+            out_e = out_e.reshape(ne, cap, h)
+
+        # combine: gather each token's slot, weight by its gate prob
+        out = out_e[expert_idx, slot]                      # (T, H)
+        out = out * (gate_prob * keep.astype(_f32))[:, None]
+        return out.astype(x.dtype), aux_loss
