@@ -45,6 +45,20 @@ _MASK = -1e30  # finite "minus infinity": exp(_MASK - m) == 0, no NaNs
 __all__ = ["flash_attention", "flash_attention_reference"]
 
 
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct for a pallas_call output.
+
+    Inside ``shard_map`` (manual mesh axes) JAX 0.9 requires the output's
+    varying-axes set to be declared explicitly; inherit it from a
+    representative input so the kernel works both standalone and under
+    an explicit-collective region (e.g. ring attention's n=1 path)."""
+    from apex_tpu.utils.collectives import manual_axes
+
+    if not manual_axes():
+        return jax.ShapeDtypeStruct(shape, dtype)
+    return jax.ShapeDtypeStruct(shape, dtype, vma=jax.typeof(like).vma)
+
+
 # ---------------------------------------------------------------------------
 # forward kernel
 # ---------------------------------------------------------------------------
@@ -266,8 +280,8 @@ def _flash_fwd_impl(q, k, v, kv_lens, causal, scale, block_q, block_k):
                   _specs(block_q, block_k, d_pad, "inner")],
         out_specs=[_specs(block_q, block_k, d_pad, "outer"),
                    _specs(block_q, block_k, d_pad, "outer_vec")],
-        out_shape=[jax.ShapeDtypeStruct((B, sq, d_pad), q.dtype),
-                   jax.ShapeDtypeStruct((B, sq, 1), _f32)],
+        out_shape=[_sds((B, sq, d_pad), q.dtype, q),
+                   _sds((B, sq, 1), _f32, q)],
         scratch_shapes=[pltpu.VMEM((block_q, 128), _f32),
                         pltpu.VMEM((block_q, 128), _f32),
                         pltpu.VMEM((block_q, d_pad), _f32)],
@@ -300,7 +314,7 @@ def _flash_bwd_impl(q, k, v, o, lse, do, kv_lens, causal, scale,
                   _specs(block_q, block_k, d_pad, "outer_vec"),
                   _specs(block_q, block_k, d_pad, "outer_vec")],
         out_specs=_specs(block_q, block_k, d_pad, "outer"),
-        out_shape=jax.ShapeDtypeStruct((B, sq, d_pad), q.dtype),
+        out_shape=_sds((B, sq, d_pad), q.dtype, q),
         scratch_shapes=[pltpu.VMEM((block_q, d_pad), _f32)],
         compiler_params=_compiler_params(),
         interpret=interpret_mode(),
@@ -320,8 +334,8 @@ def _flash_bwd_impl(q, k, v, o, lse, do, kv_lens, causal, scale,
         in_specs=[_specs(block_q, block_k, d_pad, "len"),
                   q_spec, k_spec, k_spec, q_spec, vec_spec, vec_spec],
         out_specs=[k_spec, k_spec],
-        out_shape=[jax.ShapeDtypeStruct((B, sk, d_pad), k.dtype),
-                   jax.ShapeDtypeStruct((B, sk, d_pad), v.dtype)],
+        out_shape=[_sds((B, sk, d_pad), k.dtype, k),
+                   _sds((B, sk, d_pad), v.dtype, v)],
         scratch_shapes=[pltpu.VMEM((block_k, d_pad), _f32),
                         pltpu.VMEM((block_k, d_pad), _f32)],
         compiler_params=_compiler_params(),

@@ -100,8 +100,10 @@ class MoEMLP:
         aux_loss = ne * jnp.sum(fraction * mean_prob)
 
         # deterministic capacity: token's slot = its arrival order within
-        # its expert; tokens past `cap` are dropped (zero output)
-        pos = (jnp.cumsum(onehot, axis=0) * onehot).astype(jnp.int32)
+        # its expert; tokens past `cap` are dropped (zero output).
+        # integer cumsum — an f32 count would lose exactness past 2^24
+        onehot_i = jax.nn.one_hot(expert_idx, ne, dtype=jnp.int32)
+        pos = jnp.cumsum(onehot_i, axis=0) * onehot_i
         pos_tok = jnp.max(pos, axis=-1) - 1                # (T,)
         keep = (pos_tok < cap) & (pos_tok >= 0)
         slot = jnp.clip(pos_tok, 0, cap - 1)

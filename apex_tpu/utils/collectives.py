@@ -20,16 +20,21 @@ def ensure_varying(x, axis_name):
     return jax.tree_util.tree_map(cast, x)
 
 
+def manual_axes() -> frozenset:
+    """The current trace's ``shard_map`` manual mesh axes (empty outside
+    one, or when the JAX version lacks the query)."""
+    try:
+        return frozenset(jax.sharding.get_abstract_mesh().manual_axes)
+    except (AttributeError, TypeError):
+        return frozenset()
+
+
 def is_varying(x, axis_name) -> bool:
     """True if ``x`` is device-varying over ``axis_name`` (JAX 0.9 vma
     tracking).  vma only exists for ``shard_map`` *manual* mesh axes; for a
     vmap/pmap axis (or outside any trace) the notion doesn't apply, so
     report True and let callers fall through to the normal collective."""
-    try:
-        manual = jax.sharding.get_abstract_mesh().manual_axes
-    except (AttributeError, TypeError):
-        return True
-    if axis_name not in manual:
+    if axis_name not in manual_axes():
         return True
     return axis_name in jax.typeof(x).vma
 
