@@ -5,6 +5,11 @@ autograd node and one workspace.  On TPU the entire chain is one XLA fusion
 region inside the surrounding jit — GEMMs land on the MXU, bias+activation
 fuse into their epilogues — so the module is a plain functional chain; the
 "fused" property is achieved by construction rather than by a kernel.
+
+The claim is pinned by the on-chip lane
+(``tests/test_on_chip.py::TestXlaFusionClaim``): the compiled ENTRY
+computation contains only fusions/GEMMs/plumbing — a standalone
+elementwise kernel (un-fused epilogue) fails the test.
 """
 
 from __future__ import annotations
