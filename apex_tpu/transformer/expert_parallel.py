@@ -5,12 +5,15 @@ sharding axis for a complete TPU framework).
 
 Design (the standard TPU MoE dataflow, cf. Switch Transformer / GShard):
 every device holds ``n_experts / ep`` expert FFNs and a shard of the
-token batch.  Per device: top-1 gate → capacity-bounded dispatch into an
-``(n_experts, capacity, hidden)`` buffer → ``all_to_all`` over the
-expert axis (tokens travel to the device owning their expert) → batched
-expert FFN (one einsum over the local expert stack — MXU-friendly, no
-ragged loops) → inverse ``all_to_all`` → weighted combine.  Tokens over
-capacity are dropped (contribute zero), exactly like the references.
+token batch.  Per device: top-k gate (``top_k=1`` Switch with raw top-1
+prob, ``top_k=2`` GShard with gates renormalized over the selected
+pair) → capacity-bounded dispatch into an ``(n_experts, capacity,
+hidden)`` buffer (second choices claim slots after all first choices) →
+``all_to_all`` over the expert axis (tokens travel to the device owning
+their expert) → batched expert FFN (one einsum over the local expert
+stack — MXU-friendly, no ragged loops) → inverse ``all_to_all`` →
+gate-weighted combine over the k choices.  Tokens over capacity are
+dropped (contribute zero), exactly like the references.
 
 ``axis_name=None`` runs the identical math single-device (the serial
 golden for tests).  The auxiliary output is the Switch load-balancing
@@ -36,6 +39,7 @@ class MoEConfig:
     ffn_hidden_size: int
     n_experts: int
     capacity_factor: float = 1.25
+    top_k: int = 1                           # 1 = Switch, 2 = GShard
     expert_parallel_size: int = 1
     axis_name: Optional[str] = None          # "expert" inside shard_map
     param_dtype: jnp.dtype = jnp.float32
@@ -44,6 +48,8 @@ class MoEConfig:
         if self.n_experts % self.expert_parallel_size:
             raise ValueError("n_experts must be divisible by "
                              "expert_parallel_size")
+        if not 1 <= self.top_k <= self.n_experts:
+            raise ValueError("top_k must be in [1, n_experts]")
 
     @property
     def local_experts(self):
@@ -51,7 +57,7 @@ class MoEConfig:
 
 
 class MoEMLP:
-    """Top-1 (Switch) MoE FFN.
+    """Top-k MoE FFN (``top_k=1`` Switch, ``top_k=2`` GShard).
 
     ``params = m.init_params(key)`` holds THIS DEVICE's expert stack
     (``(local_experts, ...)`` leaves) plus the replicated gate;
@@ -76,7 +82,8 @@ class MoEMLP:
 
     def _capacity(self, n_tokens: int) -> int:
         cfg = self.cfg
-        cap = int(cfg.capacity_factor * n_tokens / cfg.n_experts)
+        cap = int(cfg.capacity_factor * cfg.top_k * n_tokens
+                  / cfg.n_experts)
         return max(cap, 1)
 
     def __call__(self, params, x):
@@ -84,34 +91,49 @@ class MoEMLP:
         ep = cfg.expert_parallel_size
         t, h = x.shape
         ne, nl = cfg.n_experts, cfg.local_experts
+        k = cfg.top_k
         cap = self._capacity(t)
 
         xf = x.astype(_f32)
         logits = xf @ params["gate"].astype(_f32)          # (T, E)
         probs = jax.nn.softmax(logits, axis=-1)
-        expert_idx = jnp.argmax(probs, axis=-1)            # (T,)
-        gate_prob = jnp.take_along_axis(
-            probs, expert_idx[:, None], axis=-1)[:, 0]     # (T,)
+        topk_prob, topk_idx = jax.lax.top_k(probs, k)      # (T, k)
+        if k > 1:
+            # GShard: gates renormalized over the selected experts
+            gate_probs = topk_prob / jnp.sum(topk_prob, axis=-1,
+                                             keepdims=True)
+        else:
+            gate_probs = topk_prob      # Switch keeps the raw top-1 prob
 
-        # Switch aux loss: n_e * mean_e(fraction_e * mean_prob_e)
-        onehot = jax.nn.one_hot(expert_idx, ne, dtype=_f32)
-        fraction = jnp.mean(onehot, axis=0)
+        # aux loss over FIRST choices (Switch form; GShard's is the same
+        # statistic): n_e * sum_e(fraction_e * mean_prob_e)
+        onehot1 = jax.nn.one_hot(topk_idx[:, 0], ne, dtype=_f32)
+        fraction = jnp.mean(onehot1, axis=0)
         mean_prob = jnp.mean(probs, axis=0)
         aux_loss = ne * jnp.sum(fraction * mean_prob)
 
-        # deterministic capacity: token's slot = its arrival order within
-        # its expert; tokens past `cap` are dropped (zero output).
-        # integer cumsum — an f32 count would lose exactness past 2^24
-        onehot_i = jax.nn.one_hot(expert_idx, ne, dtype=jnp.int32)
-        pos = jnp.cumsum(onehot_i, axis=0) * onehot_i
-        pos_tok = jnp.max(pos, axis=-1) - 1                # (T,)
-        keep = (pos_tok < cap) & (pos_tok >= 0)
-        slot = jnp.clip(pos_tok, 0, cap - 1)
+        # deterministic capacity per choice: first choices claim slots
+        # first (GShard's assignment order), then second choices append.
+        # integer cumsums — f32 counts lose exactness past 2^24
+        expert_idx, slot, keep = [], [], []
+        claimed = jnp.zeros((ne,), jnp.int32)
+        for c in range(k):
+            idx_c = topk_idx[:, c]
+            onehot_i = jax.nn.one_hot(idx_c, ne, dtype=jnp.int32)
+            pos = jnp.cumsum(onehot_i, axis=0) * onehot_i
+            # pos_c >= 0 always (own one-hot contributes 1, claimed >= 0)
+            pos_c = jnp.max(pos, axis=-1) - 1 + claimed[idx_c]
+            keep_c = pos_c < cap
+            expert_idx.append(idx_c)
+            slot.append(jnp.clip(pos_c, 0, cap - 1))
+            keep.append(keep_c)
+            claimed = claimed + jnp.sum(onehot_i, axis=0)
 
         # dispatch: (E, cap, H) buffer; dropped tokens scatter nothing
         buf = jnp.zeros((ne, cap, h), _f32)
-        buf = buf.at[expert_idx, slot].add(
-            xf * keep[:, None], mode="drop")
+        for c in range(k):
+            buf = buf.at[expert_idx[c], slot[c]].add(
+                xf * keep[c][:, None], mode="drop")
 
         if cfg.axis_name is not None and ep > 1:
             # (ep, nl, cap, H): chunk e goes to the device owning expert
@@ -136,7 +158,9 @@ class MoEMLP:
                                        concat_axis=0, tiled=False)
             out_e = out_e.reshape(ne, cap, h)
 
-        # combine: gather each token's slot, weight by its gate prob
-        out = out_e[expert_idx, slot]                      # (T, H)
-        out = out * (gate_prob * keep.astype(_f32))[:, None]
+        # combine: gather each choice's slot, weight by its gate prob
+        out = jnp.zeros((t, h), _f32)
+        for c in range(k):
+            out = out + out_e[expert_idx[c], slot[c]] * (
+                gate_probs[:, c] * keep[c].astype(_f32))[:, None]
         return out.astype(x.dtype), aux_loss

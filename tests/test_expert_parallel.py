@@ -141,3 +141,92 @@ class TestExpertParallel:
             got = np.asarray(grads[k]).reshape(np.asarray(ref[k]).shape)
             np.testing.assert_allclose(got, np.asarray(ref[k]),
                                        rtol=5e-4, atol=1e-5)
+
+
+class TestTopKRouting:
+    """top_k=2 (GShard) routing: renormalized gates, second choices
+    claim slots after all first choices."""
+
+    def test_top2_uncapped_matches_dense(self, rng):
+        cfg = serial_cfg(top_k=2, capacity_factor=float(8))
+        m = MoEMLP(cfg)
+        params = m.init_params(jax.random.PRNGKey(5))
+        x = jnp.asarray(rng.randn(16, 16), jnp.float32)
+        out, _ = jax.jit(m)(params, x)
+
+        probs = np.asarray(jax.nn.softmax(
+            jnp.asarray(np.asarray(x @ params["gate"])), -1))
+        ref = np.zeros((16, 16), np.float32)
+        for t in range(16):
+            top2 = np.argsort(probs[t])[::-1][:2]
+            norm = probs[t, top2].sum()
+            for e in top2:
+                h1 = np.maximum(np.asarray(x)[t] @ np.asarray(
+                    params["w1"])[e], 0.0)
+                ref[t] += (h1 @ np.asarray(params["w2"])[e]) \
+                    * probs[t, e] / norm
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-5,
+                                   atol=2e-5)
+
+    def test_top2_ep_matches_serial(self, rng):
+        cfg_s = serial_cfg(top_k=2)
+        serial = MoEMLP(cfg_s)
+        params = serial.init_params(jax.random.PRNGKey(6))
+        x = jnp.asarray(rng.randn(64, 16), jnp.float32)
+        cfg_p = serial_cfg(top_k=2, expert_parallel_size=4,
+                           axis_name="expert")
+        par = MoEMLP(cfg_p)
+        nl = cfg_p.local_experts
+        sharded = {"gate": params["gate"],
+                   "w1": params["w1"].reshape(4, nl, *params["w1"].shape[1:]),
+                   "w2": params["w2"].reshape(4, nl, *params["w2"].shape[1:])}
+        specs = {"gate": P(), "w1": P("expert"), "w2": P("expert")}
+        mesh = jax.make_mesh((4,), ("expert",))
+
+        def local(p, xl):
+            p = dict(p, w1=p["w1"][0], w2=p["w2"][0])
+            return par(p, xl)[0]
+
+        out = jax.jit(shard_map(
+            local, mesh=mesh, in_specs=(specs, P("expert")),
+            out_specs=P("expert")))(sharded, x)
+        refs = [np.asarray(serial(params, x[s * 16:(s + 1) * 16])[0])
+                for s in range(4)]
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.concatenate(refs), rtol=2e-5,
+                                   atol=2e-5)
+
+    def test_second_choice_capacity_after_first(self, rng):
+        """capacity 1: each expert serves exactly the first token that
+        claims it — a second choice lands only on experts no FIRST
+        choice claimed (slot ordering, checked against a reference)."""
+        m = MoEMLP(serial_cfg(top_k=2,
+                              capacity_factor=8.0 / (2 * 64.0)))
+        params = m.init_params(jax.random.PRNGKey(7))
+        x = jnp.asarray(rng.randn(64, 16), jnp.float32)
+        out, _ = m(params, x)
+
+        probs = np.asarray(jax.nn.softmax(
+            jnp.asarray(np.asarray(x @ params["gate"])), -1))
+        order = np.argsort(probs, axis=-1)[:, ::-1]
+        first, second = order[:, 0], order[:, 1]
+        # reference slot assignment: first choices in token order, then
+        # second choices in token order; capacity 1 per expert
+        served = {}          # expert -> (token, choice_prob_weight)
+        for t in range(64):
+            if first[t] not in served:
+                norm = probs[t, first[t]] + probs[t, second[t]]
+                served[first[t]] = (t, 0, probs[t, first[t]] / norm)
+        for t in range(64):
+            if second[t] not in served:
+                norm = probs[t, first[t]] + probs[t, second[t]]
+                served[second[t]] = (t, 1, probs[t, second[t]] / norm)
+        expected = {t for (t, _c, _w) in served.values()}
+        got = set(np.where(np.any(np.asarray(out) != 0.0, axis=-1))[0])
+        assert got == expected, (sorted(got), sorted(expected))
+
+    def test_invalid_topk_raises(self):
+        with pytest.raises(ValueError):
+            serial_cfg(top_k=0)
+        with pytest.raises(ValueError):
+            serial_cfg(top_k=9)
