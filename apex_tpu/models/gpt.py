@@ -46,6 +46,12 @@ class GPTConfig:
     rotary: bool = True
     context_axis: Optional[str] = None         # CP: sequence sharded here
     context_mechanism: str = "ring"            # "ring" | "ulysses"
+    n_experts: int = 0                         # >0: Switch/GShard MoE FFN
+    moe_top_k: int = 1
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 1e-2
+    expert_axis: Optional[str] = None          # EP: experts sharded here
+    expert_parallel_size: int = 1
     remat: bool = False                        # jax.checkpoint each layer
     dtype: jnp.dtype = jnp.float32             # activation/compute dtype
     param_dtype: jnp.dtype = jnp.float32
@@ -64,6 +70,10 @@ class GPTConfig:
             raise ValueError(
                 f"context_mechanism must be 'ring' or 'ulysses', got "
                 f"{self.context_mechanism!r}")
+        if self.n_experts > 0 and self.tensor_parallel_size > 1:
+            raise ValueError(
+                "MoE layers do not compose with tensor parallelism yet "
+                "(shard experts over expert_axis instead)")
 
     @property
     def head_dim(self):
@@ -160,15 +170,44 @@ class ParallelMLP:
         return y
 
 
+class MoEFFN:
+    """Switch/GShard FFN in the layer slot (beyond-reference; Megatron's
+    MoE lives outside apex).  Flattens ``(b, s, h)`` to tokens for
+    :class:`apex_tpu.transformer.expert_parallel.MoEMLP` and returns
+    ``(y, aux_loss)``."""
+
+    def __init__(self, cfg: GPTConfig):
+        from apex_tpu.transformer.expert_parallel import MoEConfig, MoEMLP
+        self.moe = MoEMLP(MoEConfig(
+            hidden_size=cfg.hidden_size,
+            ffn_hidden_size=cfg.ffn_hidden_size,
+            n_experts=cfg.n_experts,
+            top_k=cfg.moe_top_k,
+            capacity_factor=cfg.moe_capacity_factor,
+            expert_parallel_size=cfg.expert_parallel_size,
+            axis_name=cfg.expert_axis,
+            param_dtype=cfg.param_dtype))
+
+    def init_params(self, key):
+        return self.moe.init_params(key)
+
+    def __call__(self, params, x):
+        b, s, h = x.shape
+        y, aux = self.moe(params, x.reshape(b * s, h))
+        return y.reshape(b, s, h), aux
+
+
 class ParallelTransformerLayer:
-    """Pre-LN transformer block (apex ParallelTransformerLayer)."""
+    """Pre-LN transformer block (apex ParallelTransformerLayer); the FFN
+    slot is dense (ParallelMLP) or MoE (``cfg.n_experts > 0``)."""
 
     def __init__(self, cfg: GPTConfig):
         self.cfg = cfg
+        self.is_moe = cfg.n_experts > 0
         self.input_layernorm = MixedFusedLayerNorm(cfg.hidden_size)
         self.post_attention_layernorm = MixedFusedLayerNorm(cfg.hidden_size)
         self.attention = ParallelAttention(cfg)
-        self.mlp = ParallelMLP(cfg)
+        self.mlp = MoEFFN(cfg) if self.is_moe else ParallelMLP(cfg)
 
     def init_params(self, key):
         k1, k2 = jax.random.split(key)
@@ -188,6 +227,9 @@ class ParallelTransformerLayer:
         with jax.named_scope("mlp"):
             h = self.post_attention_layernorm(
                 params["post_attention_layernorm"], x)
+            if self.is_moe:
+                y, aux = self.mlp(params["mlp"], h)
+                return x + y, aux
             return x + self.mlp(params["mlp"], h)
 
 
@@ -255,16 +297,22 @@ class GPTModel:
         return self._backbone_layers(params, x, cos, sin)
 
     def _backbone_layers(self, params, x, cos, sin):
+        """Returns ``(x, moe_aux_total)`` (aux is 0.0 for dense FFNs)."""
+        aux_total = jnp.zeros((), _f32)
         for layer, lp in zip(self.layers, params["layers"]):
+            call = layer
             if self.cfg.remat:
                 # trade recompute for activation memory (apex
                 # tensor_parallel.checkpoint → jax.checkpoint)
-                x = jax.checkpoint(
-                    lambda lp, x, c, s, _l=layer: _l(lp, x, c, s))(
-                        lp, x, cos, sin)
+                call = jax.checkpoint(
+                    lambda lp, x, c, s, _l=layer: _l(lp, x, c, s))
+            out = call(lp, x, cos, sin)
+            if layer.is_moe:
+                x, aux = out
+                aux_total = aux_total + aux
             else:
-                x = layer(lp, x, cos, sin)
-        return x
+                x = out
+        return x, aux_total
 
     def logits(self, params, x):
         """Tied LM head: vocab-parallel logits ``(b, s, vocab/t)``."""
@@ -275,23 +323,28 @@ class GPTModel:
 
     def __call__(self, params, tokens):
         x = self.embed(params, tokens)
-        x = self.backbone(params, x)
+        x, _ = self.backbone(params, x)
         return self.logits(params, x)
 
     apply = __call__
 
     def loss(self, params, tokens, targets):
-        """Mean next-token loss via vocab-parallel cross entropy.
+        """Mean next-token loss via vocab-parallel cross entropy (+ the
+        Switch aux load-balancing term when the FFNs are MoE).
 
         Under context parallelism the mean over local tokens is pmeaned
         across the context axis (equal shard sizes -> exact global mean).
         """
-        logits = self(params, tokens)
+        x = self.embed(params, tokens)
+        x, aux = self.backbone(params, x)
+        logits = self.logits(params, x)
         b, s, vl = logits.shape
         per = tp.vocab_parallel_cross_entropy(
             logits.reshape(b * s, vl), targets.reshape(b * s),
             axis_name=self.cfg.axis_name)
         mean = jnp.mean(per)
+        if self.cfg.n_experts > 0:
+            mean = mean + self.cfg.moe_aux_weight * aux / len(self.layers)
         if self.cfg.context_axis is not None:
             mean = jax.lax.pmean(mean, self.cfg.context_axis)
         return mean
@@ -303,6 +356,13 @@ class GPTModel:
         compiler inserts the same collectives the shard_map form writes
         explicitly (the idiomatic TPU path)."""
         from jax.sharding import PartitionSpec as P
+        if self.cfg.n_experts > 0:
+            # MoE weights replicate under GSPMD; EP sharding is the
+            # explicit shard_map path (expert_axis)
+            mlp_spec = {"gate": P(), "w1": P(), "w2": P()}
+        else:
+            mlp_spec = {"fc1": self.layers[0].mlp.fc1.partition_spec(),
+                        "fc2": self.layers[0].mlp.fc2.partition_spec()}
         layer_spec = {
             "input_layernorm": {"weight": P(), "bias": P()},
             "attention": {"qkv": self.layers[0].attention.qkv
@@ -310,8 +370,7 @@ class GPTModel:
                           "proj": self.layers[0].attention.proj
                           .partition_spec()},
             "post_attention_layernorm": {"weight": P(), "bias": P()},
-            "mlp": {"fc1": self.layers[0].mlp.fc1.partition_spec(),
-                    "fc2": self.layers[0].mlp.fc2.partition_spec()},
+            "mlp": mlp_spec,
         }
         spec = {
             "embedding": self.embedding.partition_spec(),
@@ -395,6 +454,11 @@ def pack_for_shard_map(model: GPTModel, params, n_stages: Optional[int] = None,
     from jax.sharding import PartitionSpec as P
 
     cfg = model.cfg
+    if cfg.n_experts > 0:
+        raise NotImplementedError(
+            "MoE layers are not wired into the pipeline packing; use the "
+            "serial/GSPMD form or expert_axis shard_map (see "
+            "tests/test_context_parallel.py and test_expert_parallel.py)")
     tp = cfg.tensor_parallel_size
     shards = [shard_params_for_tp(cfg, params, r) for r in range(tp)]
     if n_stages is not None:
@@ -466,6 +530,11 @@ def stack_layers_for_pipeline(layer_params, n_stages: int):
 def make_stage_fn(model: GPTModel):
     """Build the pipeline ``stage_fn``: scan this stage's stacked layer
     params over the activation (``(mb, s, h) -> (mb, s, h)``)."""
+    if model.cfg.n_experts > 0:
+        raise NotImplementedError(
+            "MoE layers are not wired into the pipeline engine (the "
+            "layer's (x, aux) output doesn't fit the stage carry); use "
+            "the serial/GSPMD form or expert_axis shard_map")
     layer = model.layers[0]       # all layers share the module config
 
     def stage_fn(stage_params, x):

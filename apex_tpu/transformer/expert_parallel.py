@@ -50,6 +50,10 @@ class MoEConfig:
                              "expert_parallel_size")
         if not 1 <= self.top_k <= self.n_experts:
             raise ValueError("top_k must be in [1, n_experts]")
+        if self.expert_parallel_size > 1 and self.axis_name is None:
+            raise ValueError(
+                "expert_parallel_size > 1 requires axis_name (the expert "
+                "mesh axis the call runs under)")
 
     @property
     def local_experts(self):

@@ -272,6 +272,6 @@ class TestStageStacking:
         stacked = stack_layers_for_pipeline(params["layers"], 1)
         got = make_stage_fn(model)(
             jax.tree_util.tree_map(lambda p: p[0], stacked), x)
-        ref = model.backbone(params, x)
+        ref, _ = model.backbone(params, x)
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                    rtol=1e-5, atol=1e-6)
