@@ -186,7 +186,8 @@ class MoEFFN:
             capacity_factor=cfg.moe_capacity_factor,
             expert_parallel_size=cfg.expert_parallel_size,
             axis_name=cfg.expert_axis,
-            param_dtype=cfg.param_dtype))
+            param_dtype=cfg.param_dtype,
+            compute_dtype=cfg.dtype))
 
     def init_params(self, key):
         return self.moe.init_params(key)

@@ -43,6 +43,8 @@ class MoEConfig:
     expert_parallel_size: int = 1
     axis_name: Optional[str] = None          # "expert" inside shard_map
     param_dtype: jnp.dtype = jnp.float32
+    compute_dtype: jnp.dtype = jnp.float32   # expert einsums/dispatch
+    # (gate softmax + aux loss always run f32)
 
     def __post_init__(self):
         if self.n_experts % self.expert_parallel_size:
@@ -133,11 +135,15 @@ class MoEMLP:
             keep.append(keep_c)
             claimed = claimed + jnp.sum(onehot_i, axis=0)
 
-        # dispatch: (E, cap, H) buffer; dropped tokens scatter nothing
-        buf = jnp.zeros((ne, cap, h), _f32)
+        # dispatch: (E, cap, H) buffer in the compute dtype (each slot
+        # receives at most one token, so low-precision add is exact);
+        # dropped tokens scatter nothing
+        cdt = cfg.compute_dtype
+        xc = x.astype(cdt)
+        buf = jnp.zeros((ne, cap, h), cdt)
         for c in range(k):
             buf = buf.at[expert_idx[c], slot[c]].add(
-                xf * keep[c][:, None], mode="drop")
+                xc * keep[c][:, None].astype(cdt), mode="drop")
 
         if cfg.axis_name is not None and ep > 1:
             # (ep, nl, cap, H): chunk e goes to the device owning expert
@@ -150,13 +156,18 @@ class MoEMLP:
         else:
             expert_in = buf                                # (E, cap, H)
 
-        # batched expert FFN: one einsum over the local expert stack
+        # batched expert FFN: one einsum over the local expert stack,
+        # operands in compute dtype (bf16 rides the MXU), f32 accumulate
         h1 = jnp.maximum(jnp.einsum(
-            "ech,ehf->ecf", expert_in, params["w1"].astype(_f32)), 0.0)
+            "ech,ehf->ecf", expert_in, params["w1"].astype(cdt),
+            preferred_element_type=_f32), 0.0).astype(cdt)
         out_e = jnp.einsum("ecf,efh->ech", h1,
-                           params["w2"].astype(_f32))
+                           params["w2"].astype(cdt),
+                           preferred_element_type=_f32)
 
         if cfg.axis_name is not None and ep > 1:
+            # return trip in compute dtype (halves the ICI traffic)
+            out_e = out_e.astype(cdt)
             out_e = out_e.reshape(nl, ep, cap, h).transpose(1, 0, 2, 3)
             out_e = jax.lax.all_to_all(out_e, cfg.axis_name, split_axis=0,
                                        concat_axis=0, tiled=False)
@@ -165,6 +176,6 @@ class MoEMLP:
         # combine: gather each choice's slot, weight by its gate prob
         out = jnp.zeros((t, h), _f32)
         for c in range(k):
-            out = out + out_e[expert_idx[c], slot[c]] * (
+            out = out + out_e[expert_idx[c], slot[c]].astype(_f32) * (
                 gate_probs[:, c] * keep[c].astype(_f32))[:, None]
         return out.astype(x.dtype), aux_loss

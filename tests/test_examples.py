@@ -59,6 +59,16 @@ class TestExamples:
              "--print-freq", "1", "--ngf", "8", "--ndf", "8",
              "--nz", "16"]))
 
+    @pytest.mark.parametrize("top_k", [1, 2])
+    def test_switch_gpt(self, top_k):
+        out = _check(_run_example(
+            "examples/moe/train_switch_gpt.py",
+            ["--n-experts", "8", "--batch-per-device", "2",
+             "--seq-len", "32", "--hidden", "32", "--layers", "1",
+             "--heads", "4", "--vocab", "64", "--steps", "2",
+             "--print-freq", "1", "--top-k", str(top_k)]))
+        assert "devices=8" in out
+
     @pytest.mark.parametrize("mechanism", ["ring", "ulysses"])
     def test_long_context(self, mechanism):
         out = _check(_run_example(
