@@ -49,13 +49,14 @@ def _raw_loss(apply_fn, params, x, y):
     return -jnp.mean(jnp.take_along_axis(logp, y[:, None], 1))
 
 
-def run_trajectory(opt_level: str, fused: bool):
+def run_trajectory(opt_level: str, fused: bool, half_dtype=None):
     """Train STEPS steps, return the loss trajectory (floats)."""
     x, y = _data()
     params = _init_params()
 
     optimizer = FusedAdam(lr=LR) if fused else None
-    state = amp.initialize(_model, optimizer, opt_level=opt_level)
+    kw = {} if half_dtype is None else {"half_dtype": half_dtype}
+    state = amp.initialize(_model, optimizer, opt_level=opt_level, **kw)
     params = state.cast_params(params)
     scaler_state = state.scaler.init()
 
@@ -136,6 +137,55 @@ class TestL1CrossProduct:
         np.testing.assert_allclose(traj, golden, rtol=tol, atol=tol,
                                    err_msg=f"{opt_level} fused={fused}")
         assert traj[-1] < traj[0] * 0.8, (opt_level, fused, traj)
+
+    @pytest.mark.parametrize("opt_level", ["O1", "O2"])
+    def test_fp16_dynamic_scaling_lane(self, golden, opt_level):
+        """The apex-faithful fp16 path: half_dtype=float16 resolves to
+        DYNAMIC loss scaling (bf16 defaults to static 1.0) and the
+        trajectory still tracks the fp32 golden.  (Scaler growth
+        mechanics are asserted in test_fp16_dynamic_scaler_engages.)"""
+        # guard the property this lane exists for: fp16 => dynamic
+        probe = amp.initialize(_model, None, opt_level=opt_level,
+                               half_dtype=jnp.float16)
+        assert probe.scaler.dynamic
+        traj = run_trajectory(opt_level, fused=True,
+                              half_dtype=jnp.float16)
+        assert all(np.isfinite(traj)), (opt_level, traj)
+        np.testing.assert_allclose(traj, golden, rtol=7e-2, atol=7e-2,
+                                   err_msg=f"fp16 {opt_level}")
+        assert traj[-1] < traj[0] * 0.8, (opt_level, traj)
+
+    def test_fp16_dynamic_scaler_engages(self):
+        """Under fp16 the scaler state is live: initialize() resolves a
+        dynamic scaler and its scale grows over non-overflow steps when
+        the growth window is short."""
+        x, y = _data()
+        params = _init_params()
+        opt = FusedAdam(lr=LR)
+        state = amp.initialize(_model, opt, opt_level="O2",
+                               half_dtype=jnp.float16,
+                               loss_scale="dynamic")
+        assert state.scaler.dynamic
+        state.scaler.scale_window = 2
+        params = state.cast_params(params)
+        sstate = state.scaler.init()
+        opt_state = opt.init(params)
+        scale0 = float(sstate.loss_scale)
+
+        @jax.jit
+        def step(params, opt_state, sstate):
+            def loss_fn(p):
+                return amp.scale_loss(
+                    _raw_loss(state.apply_fn, p, x, y), sstate)
+            _, grads = jax.value_and_grad(loss_fn)(params)
+            return amp.unscale_step(opt, grads, params, opt_state,
+                                    state.scaler, sstate)
+
+        for _ in range(5):
+            params, opt_state, sstate, finf = step(params, opt_state,
+                                                   sstate)
+            assert not bool(finf > 0)
+        assert float(sstate.loss_scale) > scale0
 
     def test_fused_vs_unfused_same_level_tight(self):
         """Fused and unfused Adam are the same math: per-level pairs must
