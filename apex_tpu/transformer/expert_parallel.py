@@ -28,7 +28,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-__all__ = ["MoEConfig", "MoEMLP"]
+__all__ = ["MoEConfig", "MoEMLP", "is_gpt_expert_leaf",
+           "localize_expert_params", "reduce_moe_grads"]
 
 _f32 = jnp.float32
 
@@ -179,3 +180,35 @@ class MoEMLP:
             out = out + out_e[expert_idx[c], slot[c]].astype(_f32) * (
                 gate_probs[:, c] * keep[c].astype(_f32))[:, None]
         return out.astype(x.dtype), aux_loss
+
+
+# -- EP training-recipe helpers ---------------------------------------------
+
+def is_gpt_expert_leaf(path) -> bool:
+    """True for a GPT MoE expert-stack leaf (``mlp.w1`` / ``mlp.w2``)."""
+    ks = jax.tree_util.keystr(path)
+    return "mlp" in ks and ("'w1'" in ks or "'w2'" in ks)
+
+
+def localize_expert_params(params, is_expert=is_gpt_expert_leaf):
+    """Drop the unit mesh axis from expert-stack leaves inside
+    ``shard_map`` (``(1, nl, ...) -> (nl, ...)``)."""
+    return jax.tree_util.tree_map_with_path(
+        lambda p, x: x[0] if is_expert(p) else x, params)
+
+
+def reduce_moe_grads(grads, axis_name: str,
+                     is_expert=is_gpt_expert_leaf):
+    """The EP gradient reduction recipe (single source of truth for the
+    example, the test and the driver dryrun).
+
+    Differentiating the LOCAL per-device loss of a mean-over-devices
+    objective: dense grads are pmean'd across the axis; expert-stack
+    grads — whose cross-device contributions the ``all_to_all``
+    transpose already routed to the owning device — divide by the axis
+    size and regain the unit mesh axis for ``out_specs``.
+    """
+    ep = jax.lax.axis_size(axis_name)
+    return jax.tree_util.tree_map_with_path(
+        lambda p, g: (g / ep)[None] if is_expert(p)
+        else jax.lax.pmean(g, axis_name), grads)

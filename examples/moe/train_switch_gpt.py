@@ -49,6 +49,8 @@ def main():
 
     from apex_tpu.models.gpt import GPTConfig, GPTModel
     from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.transformer.expert_parallel import (
+        is_gpt_expert_leaf, localize_expert_params, reduce_moe_grads)
 
     ep = len(jax.devices())
     if args.n_experts % ep:
@@ -73,9 +75,7 @@ def main():
     model = GPTModel(cfg)
     nl = args.n_experts // ep
 
-    def is_expert(path):
-        ks = jax.tree_util.keystr(path)
-        return "mlp" in ks and ("'w1'" in ks or "'w2'" in ks)
+    is_expert = is_gpt_expert_leaf
 
     # shard the expert stacks (leading (ep, nl, ...) axis); replicate
     # rest.  ep=1 trains the plain serial form (no extra axis).
@@ -95,18 +95,12 @@ def main():
 
     if ep > 1:
         def grad_fn(p, tokens, targets):
-            local = jax.tree_util.tree_map_with_path(
-                lambda path, x: x[0] if is_expert(path) else x, p)
-            # differentiate the LOCAL per-device loss (no loss collective
-            # inside grad), then reduce explicitly — global loss is
-            # mean_d L_d, so dense grads pmean over devices and expert
-            # grads (whose cross-device contributions the all_to_all
-            # transpose already routed to the owner) divide by ep
+            # differentiate the LOCAL per-device loss, then apply the
+            # shared EP reduction recipe (reduce_moe_grads)
+            local = localize_expert_params(p)
             loss, grads = jax.value_and_grad(model.loss)(local, tokens,
                                                          targets)
-            grads = jax.tree_util.tree_map_with_path(
-                lambda path, g: (g / ep)[None] if is_expert(path)
-                else jax.lax.pmean(g, "expert"), grads)
+            grads = reduce_moe_grads(grads, "expert")
             return jax.lax.pmean(loss, "expert"), grads
 
         @jax.jit

@@ -163,9 +163,8 @@ class TestL1CrossProduct:
         params = _init_params()
         opt = FusedAdam(lr=LR)
         state = amp.initialize(_model, opt, opt_level="O2",
-                               half_dtype=jnp.float16,
-                               loss_scale="dynamic")
-        assert state.scaler.dynamic
+                               half_dtype=jnp.float16)
+        assert state.scaler.dynamic       # fp16 resolves to dynamic
         state.scaler.scale_window = 2
         params = state.cast_params(params)
         sstate = state.scaler.init()

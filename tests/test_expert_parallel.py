@@ -379,9 +379,9 @@ class TestSwitchGPTGradParity:
         par = GPTModel(GPTConfig(expert_axis="expert",
                                  expert_parallel_size=ep, **kw))
 
-        def is_expert(path):
-            ks = jax.tree_util.keystr(path)
-            return "mlp" in ks and ("'w1'" in ks or "'w2'" in ks)
+        from apex_tpu.transformer.expert_parallel import (
+            is_gpt_expert_leaf as is_expert, localize_expert_params,
+            reduce_moe_grads)
 
         sharded = jax.tree_util.tree_map_with_path(
             lambda p, x: x.reshape(ep, 1, *x.shape[1:])
@@ -391,12 +391,9 @@ class TestSwitchGPTGradParity:
         mesh = jax.make_mesh((ep,), ("expert",))
 
         def grad_fn(p, tk, tg):
-            local = jax.tree_util.tree_map_with_path(
-                lambda path, x: x[0] if is_expert(path) else x, p)
+            local = localize_expert_params(p)
             loss, grads = jax.value_and_grad(par.loss)(local, tk, tg)
-            grads = jax.tree_util.tree_map_with_path(
-                lambda path, g: (g / ep)[None] if is_expert(path)
-                else jax.lax.pmean(g, "expert"), grads)
+            grads = reduce_moe_grads(grads, "expert")
             return jax.lax.pmean(loss, "expert"), grads
 
         loss, grads = jax.jit(shard_map(
