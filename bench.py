@@ -9,13 +9,16 @@ chip:
 * FusedAdam packed-bucket step vs unfused optax adam on the same params
   -> speedup (the core premise of the multi-tensor engine).
 
-MFU accounting: the denominator is calibrated IN-BENCH — a large bf16
-matmul is timed on the same device and the peak is
-``max(sustained_matmul, spec_sheet)`` — because the tunneled device's
-`device_kind` string has proven unreliable as a spec lookup (round 2
-reported a "fraction" of 16.9).  Both spec and calibrated MFU are
-reported; the headline is the calibrated one and is asserted to lie in
-(0, 1].
+MFU accounting: the tunneled device's `device_kind` spec lookup proved
+unreliable (round 2 reported a "fraction" of 16.9) AND its absolute
+timing drifts by multiples over minutes, so the headline is the MEDIAN
+over several paired passes — each pass times a large bf16 calibration
+matmul and the train step back-to-back in the same window and takes
+``achieved / max(calibration, spec, achieved)``.  Passes whose step
+outran their calibration (a calibration undershoot, mfu clamped to 1)
+are excluded from the median when any clean pass exists; the full
+per-pass spread ships in the JSON for transparency.  The headline is
+asserted to lie in (0, 1].
 """
 
 from __future__ import annotations
@@ -51,24 +54,26 @@ def _spec_peak() -> float:
     return best if best_len >= 0 else 197e12  # conservative default
 
 
-def _calibrated_peak(rounds: int = 3) -> float:
-    """Sustained bf16 matmul FLOP/s on this device (8192^3, steady state).
+_CAL_STATE = None
 
-    The tunneled device's timings are noisy, so take the MAX over several
-    median-timed rounds — an undershooting calibration would report an
-    MFU > 1, which is how round 3 found the single-round version
-    unstable.
-    """
+
+def _calibrated_peak(rounds: int = 1) -> float:
+    """Sustained bf16 matmul FLOP/s on this device (8192^3) — ONE timing
+    window per call so callers can pair it tightly with another
+    measurement.  Operands and the jitted matmul are built once and
+    cached (re-jitting per call would widen the very window gap the
+    pairing exists to close)."""
+    global _CAL_STATE
     n = 8192
-    key = jax.random.PRNGKey(0)
-    a = jax.random.normal(key, (n, n), jnp.bfloat16)
-    b = jax.random.normal(key, (n, n), jnp.bfloat16)
-
-    @jax.jit
-    def mm(a, b):
-        return jnp.dot(a, b, preferred_element_type=jnp.bfloat16)
-
-    jax.block_until_ready(mm(a, b))
+    if _CAL_STATE is None:
+        key = jax.random.PRNGKey(0)
+        a = jax.random.normal(key, (n, n), jnp.bfloat16)
+        b = jax.random.normal(key, (n, n), jnp.bfloat16)
+        mm = jax.jit(lambda a, b: jnp.dot(
+            a, b, preferred_element_type=jnp.bfloat16))
+        jax.block_until_ready(mm(a, b))          # compile outside timing
+        _CAL_STATE = (a, b, mm)
+    a, b, mm = _CAL_STATE
     best = 0.0
     for _ in range(rounds):
         iters = 10
@@ -130,30 +135,43 @@ def bench_gpt_train_step():
                                              targets)
         return loss
 
-    dt = _time_steps(run, (tokens, targets))
-    tokens_per_s = batch * seq / dt
     # PaLM-style accounting: 6*N per token (fwd+bwd) + attention term
     flops_per_token = 6 * n_params + 12 * cfg.num_layers * cfg.hidden_size \
         * seq
-    achieved = tokens_per_s * flops_per_token
     spec = _spec_peak()
-    calibrated = max(_calibrated_peak(), spec)
-    # The denominator is the best sustained FLOP/s OBSERVED on this device
-    # this run (matmul calibration, or the step itself if the calibration
-    # undershoots — tunnel timings are noisy in both directions).  This
-    # keeps the headline a true fraction in (0, 1] with its provenance
-    # recorded, instead of crashing with no artifact.
-    peak = max(calibrated, achieved)
+
+    # The tunnel's absolute timing drifts by minutes-scale factors, so an
+    # MFU whose numerator and denominator were measured in different
+    # windows is garbage (observed swings 0.29..0.89 for the same code).
+    # Pair each step measurement with its own matmul calibration in the
+    # same window, compute a per-pass MFU, and take the median pass.
+    passes = []
+    for _ in range(5):
+        cal = max(_calibrated_peak(rounds=1), spec)
+        dt = _time_steps(run, (tokens, targets), warmup=1, rounds=1)
+        achieved = batch * seq / dt * flops_per_token
+        peak = max(cal, achieved)
+        passes.append({"dt": dt, "achieved": achieved, "cal": cal,
+                       "peak": peak, "mfu": achieved / peak})
+    # a pass whose step outran its calibration (mfu clamped to 1.0) is a
+    # calibration undershoot, not evidence; prefer the unclamped passes
+    clean = [p for p in passes if p["achieved"] <= p["cal"]] or passes
+    clean.sort(key=lambda p: p["mfu"])
+    mid = clean[len(clean) // 2]
+    dt, achieved, calibrated, peak = (mid["dt"], mid["achieved"],
+                                      mid["cal"], mid["peak"])
+    tokens_per_s = batch * seq / dt
     peak_source = ("calibrated_matmul" if peak == calibrated
                    else "achieved_step (matmul calibration undershot)")
     mfu_spec = achieved / spec
-    mfu = achieved / peak
+    mfu = mid["mfu"]
     assert 0.0 < mfu <= 1.0, (
         f"calibrated MFU {mfu} outside (0, 1] — bad peak accounting")
     return {
         "n_params": n_params,
         "batch": batch,
         "seq": seq,
+        "mfu_pass_spread": [round(p["mfu"], 4) for p in passes],
         "step_time_s": dt,
         "tokens_per_s": tokens_per_s,
         "achieved_flops": achieved,
