@@ -70,10 +70,16 @@ class GPTConfig:
             raise ValueError(
                 f"context_mechanism must be 'ring' or 'ulysses', got "
                 f"{self.context_mechanism!r}")
-        if self.n_experts > 0 and self.tensor_parallel_size > 1:
+        if self.n_experts > 0 and (
+                self.ffn_hidden_size % self.tensor_parallel_size):
             raise ValueError(
-                "MoE layers do not compose with tensor parallelism yet "
-                "(shard experts over expert_axis instead)")
+                "MoE ffn_hidden_size must be divisible by "
+                "tensor_parallel_size (each expert's FFN dim is "
+                "Column/Row-sharded over the tensor axis)")
+        if self.expert_axis is not None and self.n_experts <= 0:
+            raise ValueError(
+                "expert_axis requires n_experts > 0 (the axis shards "
+                "the MoE expert stacks)")
 
     @property
     def head_dim(self):
@@ -186,6 +192,8 @@ class MoEFFN:
             capacity_factor=cfg.moe_capacity_factor,
             expert_parallel_size=cfg.expert_parallel_size,
             axis_name=cfg.expert_axis,
+            tensor_parallel_size=cfg.tensor_parallel_size,
+            tensor_axis=cfg.axis_name,
             param_dtype=cfg.param_dtype,
             compute_dtype=cfg.dtype))
 
@@ -358,9 +366,13 @@ class GPTModel:
         explicitly (the idiomatic TPU path)."""
         from jax.sharding import PartitionSpec as P
         if self.cfg.n_experts > 0:
-            # MoE weights replicate under GSPMD; EP sharding is the
-            # explicit shard_map path (expert_axis)
-            mlp_spec = {"gate": P(), "w1": P(), "w2": P()}
+            # MoE: each expert's FFN dim shards over the tensor axis
+            # (Column/Row inside the expert); the EXPERT-dim sharding is
+            # the explicit shard_map path (expert_axis)
+            from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+            mlp_spec = {"gate": P(),
+                        "w1": P(None, None, TENSOR_AXIS),
+                        "w2": P(None, TENSOR_AXIS, None)}
         else:
             mlp_spec = {"fc1": self.layers[0].mlp.fc1.partition_spec(),
                         "fc2": self.layers[0].mlp.fc2.partition_spec()}
@@ -405,6 +417,20 @@ def shard_params_for_tp(cfg: GPTConfig, params, rank: int):
     if "position_embedding" in params:
         out["position_embedding"] = params["position_embedding"]
     for lp in params["layers"]:
+        if "gate" in lp["mlp"]:
+            # MoE expert stacks: each expert is Column/Row-sharded on
+            # its FFN dim (w1 last dim, w2 middle dim); gate replicated
+            fl = cfg.ffn_hidden_size // t
+            mlp = {"gate": lp["mlp"]["gate"],
+                   "w1": lp["mlp"]["w1"][:, :, rank * fl:(rank + 1) * fl],
+                   "w2": lp["mlp"]["w2"][:, rank * fl:(rank + 1) * fl, :]}
+        else:
+            mlp = {
+                "fc1": {"weight": col(lp["mlp"]["fc1"]["weight"]),
+                        "bias": col(lp["mlp"]["fc1"]["bias"])},
+                "fc2": {"weight": row(lp["mlp"]["fc2"]["weight"]),
+                        "bias": lp["mlp"]["fc2"]["bias"]},
+            }
         out["layers"].append({
             "input_layernorm": lp["input_layernorm"],
             "post_attention_layernorm": lp["post_attention_layernorm"],
@@ -414,12 +440,7 @@ def shard_params_for_tp(cfg: GPTConfig, params, rank: int):
                 "proj": {"weight": row(lp["attention"]["proj"]["weight"]),
                          "bias": lp["attention"]["proj"]["bias"]},
             },
-            "mlp": {
-                "fc1": {"weight": col(lp["mlp"]["fc1"]["weight"]),
-                        "bias": col(lp["mlp"]["fc1"]["bias"])},
-                "fc2": {"weight": row(lp["mlp"]["fc2"]["weight"]),
-                        "bias": lp["mlp"]["fc2"]["bias"]},
-            },
+            "mlp": mlp,
         })
     return out
 
@@ -434,7 +455,9 @@ def _is_sharded(spec) -> bool:
 
 
 def pack_for_shard_map(model: GPTModel, params, n_stages: Optional[int] = None,
-                       tensor_axis: str = "model", pipe_axis: str = "pipe"):
+                       tensor_axis: Optional[str] = "model",
+                       pipe_axis: str = "pipe",
+                       expert_axis: Optional[str] = None):
     """Pack serial-init GPT params for an explicit ``shard_map`` step.
 
     TP-sharded leaves (per :meth:`GPTModel.partition_specs`) are stacked
@@ -444,7 +467,10 @@ def pack_for_shard_map(model: GPTModel, params, n_stages: Optional[int] = None,
     replicated param is split arbitrarily across devices by the backward
     collectives, and only JAX's automatic psum-of-invariant-grads restores
     the total.  With ``n_stages`` the layer stack is additionally split
-    over the pipe axis (:func:`stack_layers_for_pipeline`).
+    over the pipe axis (:func:`stack_layers_for_pipeline`).  With
+    ``expert_axis`` (MoE models) the expert stacks (``mlp.w1``/``w2``)
+    additionally split their EXPERT dim over that axis — leading mesh
+    axes are ordered ``(tp, expert, pipe)``.
 
     Returns ``(packed, in_specs, local_fn, repack_fn)``:
     ``local_fn`` strips the unit mesh axes inside ``shard_map`` to yield
@@ -455,13 +481,11 @@ def pack_for_shard_map(model: GPTModel, params, n_stages: Optional[int] = None,
     from jax.sharding import PartitionSpec as P
 
     cfg = model.cfg
-    if cfg.n_experts > 0:
-        raise NotImplementedError(
-            "MoE layers are not wired into the pipeline packing; use the "
-            "serial/GSPMD form or expert_axis shard_map (see "
-            "tests/test_context_parallel.py and test_expert_parallel.py)")
-    tp = cfg.tensor_parallel_size
-    shards = [shard_params_for_tp(cfg, params, r) for r in range(tp)]
+    n_tp = cfg.tensor_parallel_size
+    ep = cfg.expert_parallel_size if expert_axis is not None else 1
+    if expert_axis is not None and cfg.n_experts <= 0:
+        raise ValueError("expert_axis given but the model has no experts")
+    shards = [shard_params_for_tp(cfg, params, r) for r in range(n_tp)]
     if n_stages is not None:
         for sh in shards:
             sh["layers"] = stack_layers_for_pipeline(sh["layers"], n_stages)
@@ -476,31 +500,62 @@ def pack_for_shard_map(model: GPTModel, params, n_stages: Optional[int] = None,
     packed = tmap(lambda s, *xs: jnp.stack(xs) if _is_sharded(s) else xs[0],
                   *shards)
 
+    from apex_tpu.transformer.expert_parallel import is_gpt_expert_leaf
+
+    def _is_expert(path) -> bool:
+        return expert_axis is not None and is_gpt_expert_leaf(path)
+
     def path_aware(fn):
-        # layer leaves carry the extra pipe axis when pipelined
+        # layer leaves carry the extra pipe axis when pipelined; expert
+        # leaves carry the extra expert axis when expert-sharded
         def run(tree):
             out = {}
             for key, sub in tree.items():
                 in_layers = (key == "layers" and n_stages is not None)
-                out[key] = jax.tree_util.tree_map(
-                    lambda s, x: fn(s, x, in_layers), specs[key], sub,
-                    is_leaf=_is_spec_leaf)
+                out[key] = jax.tree_util.tree_map_with_path(
+                    lambda p, s, x: fn(s, x, in_layers, _is_expert(p)),
+                    specs[key], sub, is_leaf=_is_spec_leaf)
             return out
         return run
 
-    in_specs = path_aware(
-        lambda s, x, lay: (P(tensor_axis, pipe_axis) if _is_sharded(s)
-                           else P(pipe_axis)) if lay
-        else (P(tensor_axis) if _is_sharded(s) else P()))(packed)
+    if expert_axis is not None:
+        # split the expert dim (after the tp stack [+ stage axes]) into
+        # (ep, local) and move ep up to sit right after the tp stack
+        def expert_split(s, x, lay, exp):
+            if not exp:
+                return x
+            e_pos = 3 if lay else 1
+            nl = x.shape[e_pos] // ep
+            x = x.reshape(x.shape[:e_pos] + (ep, nl) + x.shape[e_pos + 1:])
+            return jnp.moveaxis(x, e_pos, 1)
+        packed = path_aware(expert_split)(packed)
 
-    local_fn = path_aware(
-        lambda s, x, lay: (x[0, 0] if _is_sharded(s) else x[0]) if lay
-        else (x[0] if _is_sharded(s) else x))
+    def spec_for(s, x, lay, exp):
+        if exp:
+            return (P(tensor_axis, expert_axis, pipe_axis) if lay
+                    else P(tensor_axis, expert_axis))
+        if lay:
+            return P(tensor_axis, pipe_axis) if _is_sharded(s) \
+                else P(pipe_axis)
+        return P(tensor_axis) if _is_sharded(s) else P()
 
-    repack_fn = path_aware(
-        lambda s, g, lay: (g[None, None] if _is_sharded(s) else g[None])
-        if lay else (g[None] if _is_sharded(s) else g))
+    def local_for(s, x, lay, exp):
+        if exp:
+            return x[0, 0, 0] if lay else x[0, 0]
+        if lay:
+            return x[0, 0] if _is_sharded(s) else x[0]
+        return x[0] if _is_sharded(s) else x
 
+    def repack_for(s, g, lay, exp):
+        if exp:
+            return g[None, None, None] if lay else g[None, None]
+        if lay:
+            return g[None, None] if _is_sharded(s) else g[None]
+        return g[None] if _is_sharded(s) else g
+
+    in_specs = path_aware(spec_for)(packed)
+    local_fn = path_aware(local_for)
+    repack_fn = path_aware(repack_for)
     return packed, in_specs, local_fn, repack_fn
 
 
@@ -530,13 +585,29 @@ def stack_layers_for_pipeline(layer_params, n_stages: int):
 
 def make_stage_fn(model: GPTModel):
     """Build the pipeline ``stage_fn``: scan this stage's stacked layer
-    params over the activation (``(mb, s, h) -> (mb, s, h)``)."""
-    if model.cfg.n_experts > 0:
-        raise NotImplementedError(
-            "MoE layers are not wired into the pipeline engine (the "
-            "layer's (x, aux) output doesn't fit the stage carry); use "
-            "the serial/GSPMD form or expert_axis shard_map")
+    params over the activation (``(mb, s, h) -> (mb, s, h)``).
+
+    For MoE models the stage activation is the pair ``(x, aux)``: the
+    Switch aux loss rides the pipeline carry with the activation
+    (ppermuted stage-to-stage as a scalar), each stage adding its local
+    layers' contributions, so the last stage holds the per-microbatch
+    total the loss term needs."""
     layer = model.layers[0]       # all layers share the module config
+
+    if model.cfg.n_experts > 0:
+        def moe_stage_fn(stage_params, carry):
+            x, aux = carry
+            cos, sin = model.rope_tables(x.shape[1])
+
+            def body(c, lp):
+                h, a = c
+                y, la = layer(lp, h, cos, sin)
+                return (y, a + la.astype(a.dtype)), None
+
+            out, _ = jax.lax.scan(body, (x, aux), stage_params)
+            return out
+
+        return moe_stage_fn
 
     def stage_fn(stage_params, x):
         cos, sin = model.rope_tables(x.shape[1])
@@ -581,6 +652,12 @@ def pipeline_loss(model: GPTModel, params, tokens, targets, *,
     axes = {pipe_axis}
     if data_axis is not None:
         axes.add(data_axis)
+    if model.cfg.expert_axis is not None:
+        # the expert axis is a batch axis for the dense compute: dense
+        # grads psum across it via the pcast transpose (see
+        # expert_parallel.vary_params_over_axis); expert-stack leaves
+        # arrive expert-varying from their sharding and are skipped
+        axes.add(model.cfg.expert_axis)
 
     def _vary(p):
         missing = tuple(axes - set(jax.typeof(p).vma))
@@ -588,20 +665,34 @@ def pipeline_loss(model: GPTModel, params, tokens, targets, *,
 
     params = jax.tree_util.tree_map(_vary, params)
 
+    moe = model.cfg.n_experts > 0
     x = _vary(jax.vmap(lambda t: model.embed(params, t))(tokens))
+    if moe:
+        # aux rides the pipeline with the activation (one scalar per
+        # microbatch, starting at 0 on entry to stage 0)
+        x = (x, _vary(jnp.zeros((tokens.shape[0],), _f32)))
     outs = spmd_pipeline(make_stage_fn(model), params["layers"], x,
                          axis_name=pipe_axis, n_virtual=n_virtual,
                          remat=remat)
 
     def head(y, t):
+        if moe:
+            y, aux = y
         logits = model.logits(params, y)
         mb, s, vl = logits.shape
         per = tp.vocab_parallel_cross_entropy(
             logits.reshape(mb * s, vl), t.reshape(mb * s),
             axis_name=model.cfg.axis_name)
-        return jnp.mean(per)
+        mean = jnp.mean(per)
+        if moe:
+            mean = mean + model.cfg.moe_aux_weight * aux \
+                / model.cfg.num_layers
+        return mean
 
     loss = last_stage_mean_loss(head, outs, targets, pipe_axis)
     if data_axis is not None:
         loss = jax.lax.pmean(loss, data_axis)
+    if moe and model.cfg.expert_axis is not None:
+        # the expert axis doubles as a batch axis for the dense compute
+        loss = jax.lax.pmean(loss, model.cfg.expert_axis)
     return loss

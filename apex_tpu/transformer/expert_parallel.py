@@ -18,6 +18,17 @@ dropped (contribute zero), exactly like the references.
 ``axis_name=None`` runs the identical math single-device (the serial
 golden for tests).  The auxiliary output is the Switch load-balancing
 loss (mean fraction·probability product, scaled by ``n_experts``).
+
+MoE composes with tensor parallelism (``tensor_axis``/
+``tensor_parallel_size``): each expert's FFN inner dim is sharded over
+the tensor axis with the Megatron Column→Row collective pairing
+(identity/psum at entry, psum/identity at exit — the same
+``mappings`` the dense ``ParallelMLP`` uses), so an expert runs as a
+Column-parallel ``w1`` einsum → ReLU → Row-parallel ``w2`` einsum.
+The expert axis (all_to_all over tokens) and the tensor axis (psum
+over the FFN reduction) are independent mesh axes and compose
+orthogonally: the all_to_all moves ``(…, hidden)`` buffers whose
+hidden dim is never sharded.
 """
 
 from __future__ import annotations
@@ -29,7 +40,8 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["MoEConfig", "MoEMLP", "is_gpt_expert_leaf",
-           "localize_expert_params", "reduce_moe_grads"]
+           "localize_expert_params", "reduce_moe_grads",
+           "vary_params_over_axis"]
 
 _f32 = jnp.float32
 
@@ -43,6 +55,8 @@ class MoEConfig:
     top_k: int = 1                           # 1 = Switch, 2 = GShard
     expert_parallel_size: int = 1
     axis_name: Optional[str] = None          # "expert" inside shard_map
+    tensor_parallel_size: int = 1            # shard each expert's FFN dim
+    tensor_axis: Optional[str] = None        # "model" inside shard_map
     param_dtype: jnp.dtype = jnp.float32
     compute_dtype: jnp.dtype = jnp.float32   # expert einsums/dispatch
     # (gate softmax + aux loss always run f32)
@@ -57,10 +71,21 @@ class MoEConfig:
             raise ValueError(
                 "expert_parallel_size > 1 requires axis_name (the expert "
                 "mesh axis the call runs under)")
+        if self.ffn_hidden_size % self.tensor_parallel_size:
+            raise ValueError("ffn_hidden_size must be divisible by "
+                             "tensor_parallel_size")
+        if self.tensor_parallel_size > 1 and self.tensor_axis is None:
+            raise ValueError(
+                "tensor_parallel_size > 1 requires tensor_axis (the "
+                "tensor mesh axis the call runs under)")
 
     @property
     def local_experts(self):
         return self.n_experts // self.expert_parallel_size
+
+    @property
+    def local_ffn(self):
+        return self.ffn_hidden_size // self.tensor_parallel_size
 
 
 class MoEMLP:
@@ -77,7 +102,7 @@ class MoEMLP:
     def init_params(self, key):
         cfg = self.cfg
         k1, k2, k3 = jax.random.split(key, 3)
-        e, h, f = cfg.local_experts, cfg.hidden_size, cfg.ffn_hidden_size
+        e, h, f = cfg.local_experts, cfg.hidden_size, cfg.local_ffn
         return {
             "gate": 0.02 * jax.random.normal(
                 k1, (h, cfg.n_experts), cfg.param_dtype),
@@ -158,13 +183,26 @@ class MoEMLP:
             expert_in = buf                                # (E, cap, H)
 
         # batched expert FFN: one einsum over the local expert stack,
-        # operands in compute dtype (bf16 rides the MXU), f32 accumulate
+        # operands in compute dtype (bf16 rides the MXU), f32 accumulate.
+        # Under tensor parallelism w1/w2 hold the f-dim shard and the
+        # Column→Row mapping pair brackets the two einsums: copy_to's
+        # backward psums the dispatch-buffer cotangent over the tensor
+        # ranks, reduce_from's forward psums the partial expert outputs
+        # (identical collective structure to the dense ParallelMLP).
+        tp_on = cfg.tensor_axis is not None and cfg.tensor_parallel_size > 1
+        if tp_on:
+            from apex_tpu.transformer.tensor_parallel import mappings as M
+            expert_in = M.copy_to_tensor_model_parallel_region(
+                expert_in, cfg.tensor_axis)
         h1 = jnp.maximum(jnp.einsum(
             "ech,ehf->ecf", expert_in, params["w1"].astype(cdt),
             preferred_element_type=_f32), 0.0).astype(cdt)
         out_e = jnp.einsum("ecf,efh->ech", h1,
                            params["w2"].astype(cdt),
                            preferred_element_type=_f32)
+        if tp_on:
+            out_e = M.reduce_from_tensor_model_parallel_region(
+                out_e, cfg.tensor_axis)
 
         if cfg.axis_name is not None and ep > 1:
             # return trip in compute dtype (halves the ICI traffic)
@@ -195,6 +233,30 @@ def localize_expert_params(params, is_expert=is_gpt_expert_leaf):
     ``shard_map`` (``(1, nl, ...) -> (nl, ...)``)."""
     return jax.tree_util.tree_map_with_path(
         lambda p, x: x[0] if is_expert(p) else x, params)
+
+
+def vary_params_over_axis(params, axis_name: str):
+    """Mark every param leaf device-varying over ``axis_name`` (leaves
+    already varying pass through).
+
+    Load-bearing for EP training under ``check_vma=True``: the expert
+    axis doubles as a batch axis for the dense compute, so dense-param
+    grads must be psummed across it.  JAX's automatic
+    psum-of-invariant-grads handles plain-jnp paths, but ``custom_vjp``
+    kernels (the Pallas LayerNorm, the TP mappings) compute their own
+    cotangents and leave them axis-varying with no way for JAX to insert
+    the reduction.  ``pcast``-ing the params varying BEFORE the compute
+    moves the reduction into pcast's transpose — a psum over the added
+    axis — uniformly for every leaf (the same mechanism
+    ``pipeline_loss`` uses for the pipe/data axes).  Do NOT use this on
+    the TENSOR axis: the Megatron mappings' custom_vjp rules already own
+    model-axis grad reduction and would double-reduce.
+    """
+    def v(p):
+        if axis_name in jax.typeof(p).vma:
+            return p
+        return jax.lax.pcast(p, (axis_name,), to="varying")
+    return jax.tree_util.tree_map(v, params)
 
 
 def reduce_moe_grads(grads, axis_name: str,

@@ -47,22 +47,27 @@ def spmd_pipeline(stage_fn: Callable, params, microbatches, *,
     Args:
       stage_fn: ``(params_chunk, x) -> y`` — this device's stage (or one
         chunk of it); activation shapes must be uniform across stages.
+        ``x``/``y`` may be arbitrary (matching) pytrees — e.g. an
+        ``(activation, aux_scalar)`` pair for MoE models whose aux loss
+        rides the pipeline with the activation.
       params: stage-local params; with ``n_virtual > 1`` every leaf has a
         leading ``(n_virtual, ...)`` chunk axis.
-      microbatches: ``(M, ...)`` microbatched activations; only stage 0's
-        value is read (other stages may pass the same array — it arrives
-        replicated from the data loader anyway).
+      microbatches: pytree of ``(M, ...)`` microbatched activations; only
+        stage 0's value is read (other stages may pass the same arrays —
+        they arrive replicated from the data loader anyway).
       remat: rematerialize the stage in backward (activation
         checkpointing; replaces apex's 1F1B memory policy).
 
     Returns:
-      ``(M, ...)`` outputs of the final logical stage (meaningful on the
-      last device; other devices hold garbage the caller masks — apex
-      likewise only has losses on the last rank).
+      pytree of ``(M, ...)`` outputs of the final logical stage
+      (meaningful on the last device; other devices hold garbage the
+      caller masks — apex likewise only has losses on the last rank).
     """
+    tmap = jax.tree_util.tree_map
     S = jax.lax.axis_size(axis_name)
     s = jax.lax.axis_index(axis_name)
-    M = microbatches.shape[0]
+    mb_leaves = jax.tree_util.tree_leaves(microbatches)
+    M = mb_leaves[0].shape[0]
     v = int(n_virtual)
     L = S * v
     T = M + L - 1
@@ -71,58 +76,64 @@ def spmd_pipeline(stage_fn: Callable, params, microbatches, *,
         stage_fn = jax.checkpoint(stage_fn)
 
     def run_chunks(params, x):
-        # x: (v, mb...) — chunk c's incoming activation
+        # x leaves: (v, mb...) — chunk c's incoming activation
         if v == 1:
-            return stage_fn(
-                jax.tree_util.tree_map(lambda p: p[0], params),
-                x[0])[None]
+            y = stage_fn(tmap(lambda p: p[0], params),
+                         tmap(lambda a: a[0], x))
+            return tmap(lambda a: a[None], y)
         return jax.vmap(stage_fn)(params, x)
 
     stacked_params = params
     if v == 1:
-        stacked_params = jax.tree_util.tree_map(lambda p: p[None],
-                                                params)
+        stacked_params = tmap(lambda p: p[None], params)
 
-    # Make every param leaf varying over the activation axes (e.g. the
-    # data axis in a dp x pp mesh): the backward scan's param-cotangent
-    # carries are varying over those axes, and JAX 0.9 requires carry vma
-    # to match.  pcast's transpose is a psum over the added axes, which is
-    # exactly the cross-device grad accumulation those params need.
-    act_vma = set(jax.typeof(microbatches).vma) | {axis_name}
+    # Every activation leaf and the scan carry must be varying over the
+    # pipe axis AND every axis any microbatch leaf varies over (e.g. the
+    # data axis in a dp x pp mesh): JAX 0.9 requires carry vma to match
+    # tick output vma.  Same for param leaves — the backward scan's
+    # param-cotangent carries vary over those axes, and pcast's transpose
+    # is a psum over the added axes, which is exactly the cross-device
+    # grad accumulation those params need.
+    act_vma = set().union(*(jax.typeof(l).vma for l in mb_leaves)) \
+        | {axis_name}
 
     def _vary(p):
         missing = tuple(act_vma - set(jax.typeof(p).vma))
         return jax.lax.pcast(p, missing, to="varying") if missing else p
 
-    stacked_params = jax.tree_util.tree_map(_vary, stacked_params)
+    stacked_params = tmap(_vary, stacked_params)
+    microbatches = tmap(_vary, microbatches)
 
     def tick(buf, t):
         # inject microbatch t at stage 0 chunk 0 (clamped gather is masked
         # out naturally: those outputs never reach a collected slot)
-        inj = microbatches[jnp.minimum(t, M - 1)]
-        x0 = jnp.where(s == 0, inj, buf[0])
-        x = jnp.concatenate([x0[None], buf[1:]], axis=0) if v > 1 \
-            else x0[None]
+        ti = jnp.minimum(t, M - 1)
+
+        def inject(m, b):
+            x0 = jnp.where(s == 0, m[ti], b[0])
+            return jnp.concatenate([x0[None], b[1:]], axis=0) if v > 1 \
+                else x0[None]
+
+        x = tmap(inject, microbatches, buf)
         y = run_chunks(stacked_params, x)
         # rotate each chunk's output one device forward
-        sent = jax.lax.ppermute(y, axis_name, _ring_perm(S))
+        sent = tmap(lambda a: jax.lax.ppermute(a, axis_name,
+                                               _ring_perm(S)), y)
         if v > 1:
             # on the wrap (stage S-1 → 0) the activation advances a chunk
-            shifted = jnp.concatenate([sent[-1:], sent[:-1]], axis=0)
-            nxt = jnp.where(s == 0, shifted, sent)
+            def wrap(a):
+                shifted = jnp.concatenate([a[-1:], a[:-1]], axis=0)
+                return jnp.where(s == 0, shifted, a)
+            nxt = tmap(wrap, sent)
         else:
             nxt = sent
-        return nxt, y[v - 1]
+        return nxt, tmap(lambda a: a[v - 1], y)
 
-    buf0 = jnp.zeros((v,) + microbatches.shape[1:], microbatches.dtype)
-    # the scan carry must be varying over the pipe axis AND every axis the
-    # microbatches vary over (e.g. the data axis in a dp x pp mesh), or the
-    # carry types won't match the tick output under JAX 0.9 vma tracking
-    vma = set(jax.typeof(microbatches).vma) | {axis_name}
-    buf0 = jax.lax.pcast(buf0, tuple(vma), to="varying")
+    buf0 = tmap(lambda m: _vary(jnp.zeros((v,) + m.shape[1:], m.dtype)),
+                microbatches)
     _, outs = jax.lax.scan(tick, buf0, jnp.arange(T))
     # microbatch m leaves the last logical stage at tick m + L - 1
-    return outs[L - 1:]
+    return tmap(lambda o: o[L - 1:], outs)
 
 
 def last_stage_mean_loss(loss_fn, outs, targets, axis_name):

@@ -289,16 +289,10 @@ class TestSwitchGPT:
         got = float(jax.jit(model.loss)(sharded, tokens, tokens))
         np.testing.assert_allclose(got, ref, rtol=1e-5)
 
-    def test_moe_rejects_tp_and_pipeline(self, rng):
-        from apex_tpu.models.gpt import GPTModel, pack_for_shard_map
-
-        with pytest.raises(ValueError, match="tensor parallelism"):
-            self._cfg(tensor_parallel_size=2, axis_name="model")
-        model = GPTModel(self._cfg())
-        params = model.init_params(jax.random.PRNGKey(2))
-        with pytest.raises(NotImplementedError, match="pipeline"):
-            pack_for_shard_map(model, params, n_stages=2,
-                               tensor_axis=None)
+    def test_moe_tp_divisibility_validated(self):
+        with pytest.raises(ValueError, match="divisible"):
+            self._cfg(ffn_hidden_size=30, tensor_parallel_size=4,
+                      axis_name="model")
 
     def test_ep_sharded_switch_gpt(self, rng):
         """GPT with experts sharded over an expert axis: tokens are
@@ -347,6 +341,186 @@ class TestSwitchGPT:
             in_specs=(specs, P("expert"), P("expert")),
             out_specs=P()))(sharded, tokens, targets))
         np.testing.assert_allclose(loss, np.mean(refs), rtol=1e-5)
+
+
+class TestMoETensorParallel:
+    """MoE x TP: each expert's FFN dim Column/Row-sharded over the
+    tensor axis must match the serial full-width expert exactly."""
+
+    def test_moe_tp_fwd_and_grads_match_serial(self, rng):
+        serial = MoEMLP(serial_cfg(n_experts=4))
+        params = serial.init_params(jax.random.PRNGKey(0))
+        x = jnp.asarray(rng.randn(32, 16), jnp.float32)
+
+        def serial_loss(p):
+            out, aux = serial(p, x)
+            return jnp.sum(out.astype(jnp.float32) ** 2) + 0.01 * aux
+
+        ref_loss = float(jax.jit(serial_loss)(params))
+        ref_g = jax.jit(jax.grad(serial_loss))(params)
+
+        tpn = 2
+        par = MoEMLP(serial_cfg(n_experts=4, tensor_parallel_size=tpn,
+                                tensor_axis="model"))
+        fl = par.cfg.local_ffn
+        sharded = {
+            "gate": params["gate"],
+            "w1": jnp.stack([params["w1"][:, :, r * fl:(r + 1) * fl]
+                             for r in range(tpn)]),
+            "w2": jnp.stack([params["w2"][:, r * fl:(r + 1) * fl, :]
+                             for r in range(tpn)])}
+        specs = {"gate": P(), "w1": P("model"), "w2": P("model")}
+        mesh = jax.make_mesh((tpn,), ("model",))
+
+        def grad_fn(p):
+            def local_loss(p):
+                p = dict(p, w1=p["w1"][0], w2=p["w2"][0])
+                out, aux = par(p, x)
+                return jnp.sum(out.astype(jnp.float32) ** 2) + 0.01 * aux
+            return jax.value_and_grad(local_loss)(p)
+
+        loss, g = jax.jit(shard_map(
+            grad_fn, mesh=mesh, in_specs=(specs,),
+            out_specs=(P(), specs)))(sharded)
+        np.testing.assert_allclose(float(loss), ref_loss, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(g["gate"]),
+                                   np.asarray(ref_g["gate"]),
+                                   rtol=5e-4, atol=1e-5)
+        for k, sl in (("w1", lambda a, r: a[:, :, r * fl:(r + 1) * fl]),
+                      ("w2", lambda a, r: a[:, r * fl:(r + 1) * fl, :])):
+            ref_sh = np.stack([sl(np.asarray(ref_g[k]), r)
+                               for r in range(tpn)])
+            np.testing.assert_allclose(np.asarray(g[k]), ref_sh,
+                                       rtol=5e-4, atol=1e-5)
+
+
+def _per_microbatch_golden(model, params, tokens, targets, mb):
+    """Serial golden for sharded-batch MoE runs: mean of per-microbatch
+    losses (MoE capacity is a per-dispatch-group statistic, so each
+    device-microbatch is computed independently)."""
+    n = tokens.shape[0] // mb
+
+    def loss(p):
+        losses = [model.loss(p, tokens[i * mb:(i + 1) * mb],
+                             targets[i * mb:(i + 1) * mb])
+                  for i in range(n)]
+        return jnp.mean(jnp.stack(losses))
+
+    return loss
+
+
+def _assert_grad_tree_allclose(grads, ref):
+    for (path, g), (_, r) in zip(
+            jax.tree_util.tree_flatten_with_path(grads)[0],
+            jax.tree_util.tree_flatten_with_path(ref)[0], strict=True):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(r), rtol=5e-4, atol=1e-5,
+            err_msg=jax.tree_util.keystr(path))
+
+
+class TestMoEComposition:
+    """The round-4 axis-product lanes: MoE composes with TP and with the
+    SPMD pipeline (and all three at once) with exact loss+grad parity
+    against the per-microbatch serial golden."""
+
+    def _models(self, n_experts=2, num_layers=2, **par_kw):
+        from apex_tpu.models.gpt import GPTConfig, GPTModel
+
+        kw = dict(vocab_size=32, hidden_size=16, num_layers=num_layers,
+                  num_attention_heads=4, max_seq_len=16,
+                  n_experts=n_experts)
+        return GPTModel(GPTConfig(**kw)), GPTModel(GPTConfig(**kw,
+                                                             **par_kw))
+
+    def test_ep_tp_switch_gpt_grad_parity(self, rng):
+        from apex_tpu.models.gpt import pack_for_shard_map
+        from apex_tpu.transformer.expert_parallel import (
+            vary_params_over_axis)
+
+        ep, tpn = 2, 2
+        serial, par = self._models(
+            n_experts=4, tensor_parallel_size=tpn, axis_name="model",
+            expert_axis="expert", expert_parallel_size=ep)
+        params = serial.init_params(jax.random.PRNGKey(0))
+        tokens = jnp.asarray(rng.randint(0, 32, (ep * 2, 16)))
+        targets = jnp.asarray(rng.randint(0, 32, (ep * 2, 16)))
+        golden = _per_microbatch_golden(serial, params, tokens, targets, 2)
+        ref_loss = float(jax.jit(golden)(params))
+        ref_g = jax.jit(jax.grad(golden))(params)
+
+        packed, in_specs, local_fn, repack_fn = pack_for_shard_map(
+            par, params, tensor_axis="model", expert_axis="expert")
+        mesh = jax.make_mesh((ep, tpn), ("expert", "model"))
+
+        def grad_fn(sp, tk, tg):
+            def loss_fn(p):
+                p = vary_params_over_axis(p, "expert")
+                return jax.lax.pmean(par.loss(p, tk, tg), "expert")
+            loss, g = jax.value_and_grad(loss_fn)(local_fn(sp))
+            return loss, repack_fn(g)
+
+        loss, grads = jax.jit(shard_map(
+            grad_fn, mesh=mesh,
+            in_specs=(in_specs, P("expert"), P("expert")),
+            out_specs=(P(), in_specs)))(packed, tokens, targets)
+        np.testing.assert_allclose(float(loss), ref_loss, rtol=1e-5)
+        ref_packed, _, _, _ = pack_for_shard_map(
+            par, ref_g, tensor_axis="model", expert_axis="expert")
+        _assert_grad_tree_allclose(grads, ref_packed)
+
+    def _pipeline_case(self, rng, tpn, pp, ep, dp):
+        from apex_tpu.models.gpt import pack_for_shard_map, pipeline_loss
+
+        Mb, mb, seq = 2, 2, 16
+        tensor_axis = "model" if tpn > 1 else None
+        serial, par = self._models(
+            tensor_parallel_size=tpn, axis_name=tensor_axis,
+            expert_axis="expert", expert_parallel_size=ep)
+        params = serial.init_params(jax.random.PRNGKey(0))
+        nshard = dp * ep * Mb
+        tokens = jnp.asarray(rng.randint(0, 32, (nshard * mb, seq)))
+        targets = jnp.asarray(rng.randint(0, 32, (nshard * mb, seq)))
+        golden = _per_microbatch_golden(serial, params, tokens, targets,
+                                        mb)
+        ref_loss = float(jax.jit(golden)(params))
+        ref_g = jax.jit(jax.grad(golden))(params)
+
+        packed, in_specs, local_fn, repack_fn = pack_for_shard_map(
+            par, params, n_stages=pp, tensor_axis=tensor_axis,
+            expert_axis="expert")
+        axes, sizes = [], []
+        if dp > 1:
+            axes.append("data"); sizes.append(dp)
+        if tpn > 1:
+            axes.append("model"); sizes.append(tpn)
+        axes += ["pipe", "expert"]; sizes += [pp, ep]
+        mesh = jax.make_mesh(tuple(sizes), tuple(axes))
+        batch_axes = (("data", "expert") if dp > 1 else ("expert",))
+
+        def grad_step(sp, tk, tg):
+            tk = tk.reshape(Mb, mb, seq)
+            tg = tg.reshape(Mb, mb, seq)
+            loss, g = jax.value_and_grad(
+                lambda p: pipeline_loss(
+                    par, p, tk, tg, pipe_axis="pipe",
+                    data_axis="data" if dp > 1 else None))(local_fn(sp))
+            return loss, repack_fn(g)
+
+        loss, grads = jax.jit(shard_map(
+            grad_step, mesh=mesh,
+            in_specs=(in_specs, P(batch_axes), P(batch_axes)),
+            out_specs=(P(), in_specs)))(packed, tokens, targets)
+        np.testing.assert_allclose(float(loss), ref_loss, rtol=1e-5)
+        ref_packed, _, _, _ = pack_for_shard_map(
+            par, ref_g, n_stages=pp, tensor_axis=tensor_axis,
+            expert_axis="expert")
+        _assert_grad_tree_allclose(grads, ref_packed)
+
+    def test_dp_pp_ep_pipeline_grad_parity(self, rng):
+        self._pipeline_case(rng, tpn=1, pp=2, ep=2, dp=2)
+
+    def test_tp_pp_ep_full_product_grad_parity(self, rng):
+        self._pipeline_case(rng, tpn=2, pp=2, ep=2, dp=1)
 
 
 class TestSwitchGPTGradParity:
