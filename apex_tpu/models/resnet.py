@@ -47,9 +47,23 @@ def resnet50(**kw) -> "ResNet":
     return ResNet(ResNetConfig(depths=(3, 4, 6, 3), **kw))
 
 
-def resnet18(**kw) -> "ResNet":
-    # basic-block resnets are out of scope; 18 maps to a thin bottleneck
+def resnet26(**kw) -> "ResNet":
+    """Bottleneck (2, 2, 2, 2) network — the thin end of this family.
+
+    Every block here is a bottleneck with 4x expansion, so this is
+    torchvision's *resnet26*-shaped network, NOT basic-block ResNet-18
+    (different depth and ~2x the parameters).  Basic blocks are out of
+    scope for this family; recipes expecting torchvision ``resnet18``
+    weights/params must not assume parity with this constructor.
+    """
     return ResNet(ResNetConfig(depths=(2, 2, 2, 2), **kw))
+
+
+def resnet18(**kw) -> "ResNet":
+    """Deprecated alias for :func:`resnet26` — kept for recipe-name
+    parity only; see that docstring for why the shapes differ from
+    torchvision's basic-block ResNet-18."""
+    return resnet26(**kw)
 
 
 def _conv_init(key, kh, kw, cin, cout, dtype):

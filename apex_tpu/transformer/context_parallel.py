@@ -142,7 +142,8 @@ def ulysses_attention(q, k, v, axis_name: str = "context",
                                softmax_scale=softmax_scale,
                                block_q=block_q, block_k=block_k)
     if h % n:
-        raise ValueError(f"heads ({h}) must divide the context axis ({n})")
+        raise ValueError(
+            f"heads ({h}) must be divisible by the context axis size ({n})")
 
     def to_seq(x):
         # (b, h, sl, d) -> (b, h/n, n*sl, d): split heads over the axis,

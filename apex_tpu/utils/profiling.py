@@ -21,26 +21,35 @@ from __future__ import annotations
 
 import contextlib
 import hashlib
+import threading
 from typing import Any, Callable
 
 import jax
 
 named_scope = jax.named_scope        # re-export: the nvtx range analogue
 
-_SCOPE_STACK: list = []
+_SCOPES = threading.local()          # per-thread stack: pops must nest
 
 
 def range_push(name: str) -> None:
     """``torch.cuda.nvtx.range_push`` equivalent (paired with
-    :func:`range_pop`); prefer the :func:`annotate` context manager."""
+    :func:`range_pop`); prefer the :func:`annotate` context manager.
+
+    The push/pop stack is per-thread and pops must nest within their
+    thread — interleaving pairs across threads is undefined, as it was
+    for nvtx ranges.
+    """
     cm = jax.named_scope(name)
     cm.__enter__()
-    _SCOPE_STACK.append(cm)
+    if not hasattr(_SCOPES, "stack"):
+        _SCOPES.stack = []
+    _SCOPES.stack.append(cm)
 
 
 def range_pop() -> None:
-    if _SCOPE_STACK:
-        _SCOPE_STACK.pop().__exit__(None, None, None)
+    stack = getattr(_SCOPES, "stack", None)
+    if stack:
+        stack.pop().__exit__(None, None, None)
 
 
 @contextlib.contextmanager

@@ -200,7 +200,8 @@ class TestUlyssesAttention:
     def test_heads_must_divide(self, rng):
         q, k, v = make_qkv(rng, h=2)
         mesh = jax.make_mesh((4,), ("context",))
-        with pytest.raises(ValueError, match="divide"):
+        with pytest.raises(ValueError,
+                           match="divisible by the context axis"):
             run_sharded(
                 lambda q, k, v: ulysses_attention(q, k, v, "context"),
                 mesh, q, k, v)
