@@ -11,8 +11,9 @@ length contribute nothing), and gathered back to the packed layout.
 
 ``fmha(qkv, cu_seqlens, max_s)`` with ``qkv`` of shape
 ``(total_tokens, 3, heads, head_dim)`` mirrors ``FMHAFun.apply``.
-Probability dropout (``p_dropout > 0``) uses the materialized-probs
-reference path and needs a ``dropout_rng``.
+Probability dropout (``p_dropout > 0``) is fused into the kernel (the
+reference's philox-fused dropout); ``dropout_rng`` seeds the
+counter-hash keep mask.
 """
 
 from __future__ import annotations
@@ -57,14 +58,16 @@ def fmha(qkv, cu_seqlens, max_s, p_dropout=0.0, is_training=True,
     k = dense[:, :, 1].transpose(0, 2, 1, 3)
     v = dense[:, :, 2].transpose(0, 2, 1, 3)
 
+    seed = None
     if p_dropout > 0.0 and is_training:
         if dropout_rng is None:
             raise ValueError("p_dropout > 0 needs dropout_rng")
-        ctx = flash_attention_reference(
-            q, k, v, causal=causal, kv_seqlens=lens, dropout=p_dropout,
-            dropout_rng=dropout_rng)
+        seed = jax.random.randint(dropout_rng, (), 0, 2 ** 31 - 1,
+                                  jnp.int32)
     else:
-        ctx = flash_attention(q, k, v, causal=causal, kv_seqlens=lens)
+        p_dropout = 0.0
+    ctx = flash_attention(q, k, v, causal=causal, kv_seqlens=lens,
+                          dropout=p_dropout, dropout_seed=seed)
 
     # gather back to the packed token axis
     ctx = ctx.transpose(0, 2, 1, 3)               # (b, s, h, d)

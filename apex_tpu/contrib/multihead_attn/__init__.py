@@ -11,11 +11,13 @@ MHA: activations are ``(seq, batch, hidden)``.
 
 ``include_norm_add=True`` mirrors apex's ``*_norm_add`` variants: the
 input is layer-normed before projection and the residual added to the
-output.  Attention-probability dropout needs the materialized-probs path
-(the flash kernel never forms probabilities); with ``dropout > 0`` and
-``is_training=True`` the module uses the jnp reference and requires a
-``dropout_rng`` key — pass ``is_training=False`` (or dropout 0) for the
-fused inference/eval path.
+output.  Attention-probability dropout is FUSED into the flash kernel
+(counter-hash keep mask regenerated in the backward — the reference's
+philox-fused dropout, ``apex/contrib/csrc/multihead_attn/dropout.cuh``),
+so training with dropout keeps the O(s) memory path; ``dropout > 0``
+with ``is_training=True`` requires a ``dropout_rng`` key, which seeds
+the mask.  Only an arbitrary ``key_padding_mask`` still needs the
+materialized-probabilities reference path.
 """
 
 from __future__ import annotations
@@ -61,17 +63,24 @@ def _attend(q, k, v, heads, causal, kv_seqlens, key_padding_mask,
     qh = q.reshape(sq, b, heads, d).transpose(1, 2, 0, 3)
     kh = k.reshape(sk, b, heads, d).transpose(1, 2, 0, 3)
     vh = v.reshape(sk, b, heads, d).transpose(1, 2, 0, 3)
-    if key_padding_mask is not None or dropout > 0.0:
-        # arbitrary masks / prob-dropout need materialized probabilities;
-        # the reference path owns that logic (incl. kv_seqlens + fully
-        # masked rows) so the two paths cannot drift
+    if key_padding_mask is not None:
+        # arbitrary masks need materialized probabilities; the reference
+        # path owns that logic (incl. kv_seqlens + fully masked rows) so
+        # the two paths cannot drift
         ctx = flash_attention_reference(
             qh, kh, vh, causal=causal, kv_seqlens=kv_seqlens,
             key_padding_mask=key_padding_mask, dropout=dropout,
             dropout_rng=dropout_rng)
     else:
+        seed = None
+        if dropout > 0.0:
+            # the key seeds the kernel's counter-hash mask; same key =>
+            # same mask, so training steps should split a fresh key
+            seed = jax.random.randint(dropout_rng, (), 0, 2 ** 31 - 1,
+                                      jnp.int32)
         ctx = flash_attention(qh, kh, vh, causal=causal,
-                              kv_seqlens=kv_seqlens)
+                              kv_seqlens=kv_seqlens, dropout=dropout,
+                              dropout_seed=seed)
     return ctx.transpose(2, 0, 1, 3).reshape(sq, b, hidden)
 
 

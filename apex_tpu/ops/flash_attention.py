@@ -21,7 +21,10 @@ cap; unlike the pre-flash ``multihead_attn`` kernels the memory is O(s)
 not O(s^2).  Padding parity: the reference packs variable-length batches
 via ``cu_seqlens``; here batches are dense ``(b, h, s, d)`` with an
 optional per-batch ``kv_seqlens`` — key positions >= the row's length are
-masked out, matching the packed semantics on padded inputs.
+masked out, matching the packed semantics on padded inputs.  Probability
+dropout is fused into all three kernels via a counter-hash keep mask
+(see the "fused probability dropout" section below), the reference's
+philox-fused design without the O(s^2) mask storage.
 
 Off-TPU the same semantics run as a materialized jnp reference (the unit
 suite compares the two; on TPU the Pallas path is the default).
@@ -45,6 +48,78 @@ _MASK = -1e30  # finite "minus infinity": exp(_MASK - m) == 0, no NaNs
 __all__ = ["flash_attention", "flash_attention_reference"]
 
 
+# ---------------------------------------------------------------------------
+# fused probability dropout
+# ---------------------------------------------------------------------------
+#
+# The reference fuses philox-counter dropout into the probability tile
+# (apex/contrib/csrc/multihead_attn/dropout.cuh, philox.h): the mask is a
+# pure function of (seed, position), so forward and backward regenerate it
+# instead of storing an O(s^2) mask.  Same design here, with a
+# lowbias32-style integer hash instead of philox: pure jnp/lax integer
+# math, so the SAME function runs inside the Pallas kernels (compiled or
+# interpret mode) and in the dense jnp fallback — the mask is bit-identical
+# across all paths and invariant to the kernel's block-size choice.
+#
+# Dropout semantics: inverted dropout on the NORMALIZED probabilities —
+# the softmax denominator ``l`` accumulates the undropped ``p`` (the saved
+# logsumexp is dropout-free), and the keep/(1-rate) factor applies only to
+# the PV matmul.  Backward: with D the keep-scale matrix and P the
+# undropped probabilities, ``o = (P∘D)V`` gives ``dV = (P∘D)^T dO``,
+# ``dS = P∘(D∘(dO V^T) - delta)`` where ``delta = rowsum(dO∘O)`` — the
+# delta trick survives dropout unchanged because
+# ``rowsum(dO∘O) = rowsum(P∘D∘(dO V^T))``.
+
+
+def _mix32(x):
+    """lowbias32 avalanche mix (public-domain integer hash)."""
+    x = (x ^ (x >> 16)) * jnp.uint32(0x7FEB352D)
+    x = (x ^ (x >> 15)) * jnp.uint32(0x846CA68B)
+    return x ^ (x >> 16)
+
+
+def _dropout_hash(seed, bh, q_pos, k_pos):
+    """uint32 hash of (seed, batch*head index, q position, k position).
+
+    ``seed``/``bh`` are scalars, ``q_pos``/``k_pos`` integer arrays that
+    broadcast against each other; chained mixing (not a packed linear
+    counter) so large sequence extents cannot alias by overflow.
+    """
+    h = _mix32(jnp.asarray(bh).astype(jnp.uint32)
+               ^ _mix32(jnp.asarray(seed).astype(jnp.uint32)))
+    h = _mix32(h ^ q_pos.astype(jnp.uint32))
+    return _mix32(h ^ k_pos.astype(jnp.uint32))
+
+
+def _keep_threshold(rate):
+    """Static uint32 threshold with P(hash >= threshold) = 1 - rate."""
+    return jnp.uint32(min(max(int(round(rate * 2.0 ** 32)), 0),
+                          2 ** 32 - 1))
+
+
+def _keep_scale_tile(seed, bh, qi, ki, block_q, block_k, rate):
+    """(block_q, block_k) f32 tile of keep/(1-rate) factors ("D")."""
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    h = _dropout_hash(seed, bh, q_pos, k_pos)
+    return jnp.where(h >= _keep_threshold(rate),
+                     jnp.float32(1.0 / (1.0 - rate)), 0.0)
+
+
+def dropout_keep_scale(seed, n_bh, sq, sk, rate):
+    """Dense ``(n_bh, sq, sk)`` keep-scale matrix — the SAME hash the
+    fused kernels regenerate per tile, materialized (for the jnp
+    fallback and for parity tests against the fused path)."""
+    bh = jnp.arange(n_bh, dtype=jnp.int32)[:, None, None]
+    q_pos = jnp.arange(sq, dtype=jnp.int32)[None, :, None]
+    k_pos = jnp.arange(sk, dtype=jnp.int32)[None, None, :]
+    h = _dropout_hash(seed, bh, q_pos, k_pos)
+    return jnp.where(h >= _keep_threshold(rate),
+                     jnp.float32(1.0 / (1.0 - rate)), 0.0)
+
+
 def _sds(shape, dtype, like):
     """ShapeDtypeStruct for a pallas_call output.
 
@@ -63,8 +138,8 @@ def _sds(shape, dtype, like):
 # forward kernel
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(causal, scale, sq, block_q, block_k,
-                len_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+def _fwd_kernel(causal, scale, rate, sq, block_q, block_k,
+                len_ref, seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr):
     b = pl.program_id(0)
     qi = pl.program_id(1)
@@ -95,7 +170,12 @@ def _fwd_kernel(causal, scale, sq, block_q, block_k,
         m_cur = jnp.maximum(jnp.max(s, axis=1, keepdims=True), m_prev)
         alpha = jnp.exp(m_prev - m_cur)
         p = jnp.where(valid, jnp.exp(s - m_cur), 0.0)
+        # l accumulates the UNDROPPED p (softmax normalizes pre-dropout);
+        # the keep/(1-rate) factor touches only the PV matmul
         l_cur = alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        if rate > 0.0:
+            p = p * _keep_scale_tile(seed_ref[0], b, qi, ki, block_q,
+                                     block_k, rate)
         pv = jax.lax.dot_general(
             p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=_f32)
@@ -140,9 +220,9 @@ def _recompute_p(causal, scale, qi, ki, block_q, block_k, kv_len,
     return p, valid
 
 
-def _dq_kernel(causal, scale, sq, block_q, block_k,
-               len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-               dq_ref, dq_scr):
+def _dq_kernel(causal, scale, rate, sq, block_q, block_k,
+               len_ref, seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+               delta_ref, dq_ref, dq_scr):
     b = pl.program_id(0)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -162,6 +242,10 @@ def _dq_kernel(causal, scale, sq, block_q, block_k,
         dp = jax.lax.dot_general(do, v_ref[0].astype(_f32),
                                  (((1,), (1,)), ((), ())),
                                  preferred_element_type=_f32)
+        if rate > 0.0:
+            # dP = D∘(dO V^T): regenerate the forward's mask for this tile
+            dp = dp * _keep_scale_tile(seed_ref[0], b, qi, ki, block_q,
+                                       block_k, rate)
         ds = p * (dp - delta_ref[0]) * scale
         dq_scr[:] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
                                          preferred_element_type=_f32)
@@ -178,9 +262,9 @@ def _dq_kernel(causal, scale, sq, block_q, block_k,
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(causal, scale, sq, block_q, block_k,
-                len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_scr, dv_scr):
+def _dkv_kernel(causal, scale, rate, sq, block_q, block_k,
+                len_ref, seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                delta_ref, dk_ref, dv_ref, dk_scr, dv_scr):
     b = pl.program_id(0)
     ki = pl.program_id(1)
     qi = pl.program_id(2)
@@ -204,11 +288,22 @@ def _dkv_kernel(causal, scale, sq, block_q, block_k,
         q_pos = qi * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
         p = jnp.where(q_pos < sq, p, 0.0)
-        dv_scr[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+        if rate > 0.0:
+            # same (seed, b, qi, ki) stream as the forward — note this
+            # kernel's grid is (B, k, q), so the logical (qi, ki) pair is
+            # (program_id(2), program_id(1))
+            dmask = _keep_scale_tile(seed_ref[0], b, qi, ki, block_q,
+                                     block_k, rate)
+            pd = p * dmask
+        else:
+            pd = p
+        dv_scr[:] += jax.lax.dot_general(pd, do, (((0,), (0,)), ((), ())),
                                          preferred_element_type=_f32)
         dp = jax.lax.dot_general(do, v_ref[0].astype(_f32),
                                  (((1,), (1,)), ((), ())),
                                  preferred_element_type=_f32)
+        if rate > 0.0:
+            dp = dp * dmask
         ds = p * (dp - delta_ref[0]) * scale
         dk_scr[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
                                          preferred_element_type=_f32)
@@ -264,17 +359,19 @@ def _compiler_params():
         dimension_semantics=("parallel", "parallel", "arbitrary"))
 
 
-def _flash_fwd_impl(q, k, v, kv_lens, causal, scale, block_q, block_k):
+def _flash_fwd_impl(q, k, v, kv_lens, seed, causal, scale, rate,
+                    block_q, block_k):
     """q,k,v: (B, s, d) padded inputs; returns (o, lse) padded."""
     B, sq, d_pad = q.shape
     sk = k.shape[1]
     nq, nk = sq // block_q, sk // block_k
-    kernel = functools.partial(_fwd_kernel, causal, scale, sq, block_q,
-                               block_k)
+    kernel = functools.partial(_fwd_kernel, causal, scale, rate, sq,
+                               block_q, block_k)
     o, lse = pl.pallas_call(
         kernel,
         grid=(B, nq, nk),
         in_specs=[_specs(block_q, block_k, d_pad, "len"),
+                  _specs(block_q, block_k, d_pad, "len"),
                   _specs(block_q, block_k, d_pad, "outer"),
                   _specs(block_q, block_k, d_pad, "inner"),
                   _specs(block_q, block_k, d_pad, "inner")],
@@ -287,12 +384,12 @@ def _flash_fwd_impl(q, k, v, kv_lens, causal, scale, block_q, block_k):
                         pltpu.VMEM((block_q, d_pad), _f32)],
         compiler_params=_compiler_params(),
         interpret=interpret_mode(),
-    )(kv_lens, q, k, v)
+    )(kv_lens, seed, q, k, v)
     return o, lse
 
 
-def _flash_bwd_impl(q, k, v, o, lse, do, kv_lens, causal, scale,
-                    block_q, block_k, true_sq):
+def _flash_bwd_impl(q, k, v, o, lse, do, kv_lens, seed, causal, scale,
+                    rate, block_q, block_k, true_sq):
     """``true_sq`` is the UNPADDED query length — the dkv kernel's
     padded-row guard must compare against it, not the padded extent."""
     B, sq, d_pad = q.shape
@@ -301,12 +398,13 @@ def _flash_bwd_impl(q, k, v, o, lse, do, kv_lens, causal, scale,
     delta = jnp.sum(do.astype(_f32) * o.astype(_f32), axis=-1,
                     keepdims=True)                              # (B, sq, 1)
 
-    dq_kernel = functools.partial(_dq_kernel, causal, scale, sq, block_q,
-                                  block_k)
+    dq_kernel = functools.partial(_dq_kernel, causal, scale, rate, sq,
+                                  block_q, block_k)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(B, nq, nk),
         in_specs=[_specs(block_q, block_k, d_pad, "len"),
+                  _specs(block_q, block_k, d_pad, "len"),
                   _specs(block_q, block_k, d_pad, "outer"),
                   _specs(block_q, block_k, d_pad, "inner"),
                   _specs(block_q, block_k, d_pad, "inner"),
@@ -318,11 +416,11 @@ def _flash_bwd_impl(q, k, v, o, lse, do, kv_lens, causal, scale,
         scratch_shapes=[pltpu.VMEM((block_q, d_pad), _f32)],
         compiler_params=_compiler_params(),
         interpret=interpret_mode(),
-    )(kv_lens, q, k, v, do, lse, delta)
+    )(kv_lens, seed, q, k, v, do, lse, delta)
 
     # dk/dv: swap the roles — grid dim 1 walks k blocks, dim 2 walks q
-    dkv_kernel = functools.partial(_dkv_kernel, causal, scale, true_sq,
-                                   block_q, block_k)
+    dkv_kernel = functools.partial(_dkv_kernel, causal, scale, rate,
+                                   true_sq, block_q, block_k)
     q_spec = pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, j, 0),
                           memory_space=pltpu.VMEM)
     k_spec = pl.BlockSpec((1, block_k, d_pad), lambda b, i, j: (b, i, 0),
@@ -332,6 +430,7 @@ def _flash_bwd_impl(q, k, v, o, lse, do, kv_lens, causal, scale,
         dkv_kernel,
         grid=(B, nk, nq),
         in_specs=[_specs(block_q, block_k, d_pad, "len"),
+                  _specs(block_q, block_k, d_pad, "len"),
                   q_spec, k_spec, k_spec, q_spec, vec_spec, vec_spec],
         out_specs=[k_spec, k_spec],
         out_shape=[_sds((B, sk, d_pad), k.dtype, k),
@@ -340,7 +439,7 @@ def _flash_bwd_impl(q, k, v, o, lse, do, kv_lens, causal, scale,
                         pltpu.VMEM((block_k, d_pad), _f32)],
         compiler_params=_compiler_params(),
         interpret=interpret_mode(),
-    )(kv_lens, q, k, v, do, lse, delta)
+    )(kv_lens, seed, q, k, v, do, lse, delta)
     return dq, dk, dv
 
 
@@ -348,10 +447,11 @@ def _flash_bwd_impl(q, k, v, o, lse, do, kv_lens, causal, scale,
 # custom-VJP wrapper over (b, h, s, d)
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash(q, k, v, kv_seqlens, causal, scale, block_q, block_k):
-    out, _ = _flash_vjp_fwd(q, k, v, kv_seqlens, causal, scale, block_q,
-                            block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash(q, k, v, kv_seqlens, seed, causal, scale, block_q, block_k,
+           rate):
+    out, _ = _flash_vjp_fwd(q, k, v, kv_seqlens, seed, causal, scale,
+                            block_q, block_k, rate)
     return out
 
 
@@ -368,27 +468,29 @@ def _flatten(q, k, v, kv_seqlens, block_q, block_k):
     return q3, k3, v3, lens
 
 
-def _flash_vjp_fwd(q, k, v, kv_seqlens, causal, scale, block_q, block_k):
+def _flash_vjp_fwd(q, k, v, kv_seqlens, seed, causal, scale, block_q,
+                   block_k, rate):
     b, h, sq, d = q.shape
     q3, k3, v3, lens = _flatten(q, k, v, kv_seqlens, block_q, block_k)
-    o3, lse = _flash_fwd_impl(q3, k3, v3, lens, causal, scale, block_q,
-                              block_k)
+    o3, lse = _flash_fwd_impl(q3, k3, v3, lens, seed, causal, scale,
+                              rate, block_q, block_k)
     out = o3[:, :sq, :d].reshape(b, h, sq, d)
-    return out, (q, k, v, kv_seqlens, o3, lse)
+    return out, (q, k, v, kv_seqlens, seed, o3, lse)
 
 
-def _flash_vjp_bwd(causal, scale, block_q, block_k, res, g):
-    q, k, v, kv_seqlens, o3, lse = res
+def _flash_vjp_bwd(causal, scale, block_q, block_k, rate, res, g):
+    q, k, v, kv_seqlens, seed, o3, lse = res
     b, h, sq, d = q.shape
     sk = k.shape[2]
     q3, k3, v3, lens = _flatten(q, k, v, kv_seqlens, block_q, block_k)
     do3 = _pad_qkv(g.reshape(b * h, sq, d), q3.shape[1], q3.shape[2])
-    dq3, dk3, dv3 = _flash_bwd_impl(q3, k3, v3, o3, lse, do3, lens,
-                                    causal, scale, block_q, block_k, sq)
+    dq3, dk3, dv3 = _flash_bwd_impl(q3, k3, v3, o3, lse, do3, lens, seed,
+                                    causal, scale, rate, block_q, block_k,
+                                    sq)
     dq = dq3[:, :sq, :d].reshape(b, h, sq, d).astype(q.dtype)
     dk = dk3[:, :sk, :d].reshape(b, h, sk, d).astype(k.dtype)
     dv = dv3[:, :sk, :d].reshape(b, h, sk, d).astype(v.dtype)
-    return dq, dk, dv, None
+    return dq, dk, dv, None, None
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -400,16 +502,22 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 def flash_attention_reference(q, k, v, causal=False, softmax_scale=None,
                               kv_seqlens=None, key_padding_mask=None,
-                              dropout=0.0, dropout_rng=None):
+                              dropout=0.0, dropout_rng=None,
+                              dropout_mask=None):
     """Materialized-scores reference with identical masking semantics —
     the unfused baseline every fused op is tested against, and the
     single fallback for features the flash kernel cannot express
-    (arbitrary ``key_padding_mask``, probability dropout; contrib
-    ``multihead_attn``/``fmha`` delegate here for those).
+    (arbitrary ``key_padding_mask``; contrib ``multihead_attn``/``fmha``
+    delegate here for those).
 
     ``key_padding_mask``: ``(b, sk)`` bool, True = masked out (apex
     convention).  A fully masked row yields a zero output, matching the
     kernel's ``l == 0`` guard.
+
+    Dropout: ``dropout_mask`` is an explicit ``(b, h, sq, sk)``
+    keep-scale matrix multiplied into the probabilities (how the fused
+    kernel's hash mask is replayed for parity tests / the jnp fallback);
+    ``dropout``+``dropout_rng`` is the ``jax.random`` variant.
     """
     b, h, sq, d = q.shape
     sk = k.shape[2]
@@ -427,7 +535,9 @@ def flash_attention_reference(q, k, v, causal=False, softmax_scale=None,
     s = jnp.where(valid, s, _MASK)
     p = jax.nn.softmax(s, axis=-1)
     p = jnp.where(valid, p, 0.0)
-    if dropout > 0.0:
+    if dropout_mask is not None:
+        p = p * dropout_mask.astype(p.dtype)
+    elif dropout > 0.0:
         if dropout_rng is None:
             raise ValueError("dropout > 0 needs dropout_rng")
         keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout, p.shape)
@@ -436,7 +546,8 @@ def flash_attention_reference(q, k, v, causal=False, softmax_scale=None,
 
 
 def flash_attention(q, k, v, causal=False, softmax_scale=None,
-                    kv_seqlens=None, block_q=128, block_k=128):
+                    kv_seqlens=None, block_q=128, block_k=128,
+                    dropout=0.0, dropout_seed=None):
     """Fused attention over ``(batch, heads, seq, head_dim)`` operands.
 
     ``causal=True`` applies the upper-triangular mask (requires
@@ -444,6 +555,14 @@ def flash_attention(q, k, v, causal=False, softmax_scale=None,
     valid key lengths (True padding parity with the reference's
     ``cu_seqlens`` packing).  ``softmax_scale`` defaults to
     ``head_dim**-0.5``.
+
+    ``dropout``: probability dropout fused into the kernel (reference:
+    apex's philox-fused attention dropout) — the keep mask is a
+    counter-hash of ``(dropout_seed, batch*head, q_pos, k_pos)``
+    regenerated in the backward, so memory stays O(s).  ``dropout_seed``
+    is an int (or traced int scalar); fold the training step counter in
+    for fresh masks per step.  The mask is identical on every backend
+    and for every block-size choice.
     """
     b, h, sq, d = q.shape
     sk = k.shape[2]
@@ -451,9 +570,21 @@ def flash_attention(q, k, v, causal=False, softmax_scale=None,
         raise ValueError("causal flash attention requires sq == sk")
     scale = float(softmax_scale if softmax_scale is not None
                   else d ** -0.5)
+    rate = float(dropout)
+    if not 0.0 <= rate < 1.0:
+        raise ValueError(f"dropout must be in [0, 1), got {rate}")
+    if rate > 0.0 and dropout_seed is None:
+        raise ValueError("dropout > 0 needs dropout_seed")
     if not use_pallas():
-        return flash_attention_reference(q, k, v, causal, scale, kv_seqlens)
+        mask = None
+        if rate > 0.0:
+            mask = dropout_keep_scale(dropout_seed, b * h, sq, sk,
+                                      rate).reshape(b, h, sq, sk)
+        return flash_attention_reference(q, k, v, causal, scale,
+                                         kv_seqlens, dropout_mask=mask)
     if kv_seqlens is None:
         kv_seqlens = jnp.full((b,), sk, jnp.int32)
-    return _flash(q, k, v, kv_seqlens, bool(causal), scale, int(block_q),
-                  int(block_k))
+    seed = jnp.reshape(jnp.asarray(
+        0 if dropout_seed is None else dropout_seed, jnp.int32), (1,))
+    return _flash(q, k, v, kv_seqlens, seed, bool(causal), scale,
+                  int(block_q), int(block_k), rate)
