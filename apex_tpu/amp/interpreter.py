@@ -11,9 +11,13 @@ differentiates through the inserted casts exactly as torch autograd does for
 apex's forward-inserted casts.
 
 Higher-order primitives: ``pjit``/``closed_call``/``remat`` bodies are
-recursed into; control-flow (``scan``/``while``/``cond``) is left intact
-with inputs restored to the traced dtypes — casting across a loop-carry
-boundary would change carry dtypes mid-loop.  Custom-derivative calls
+recursed into.  Control-flow (``scan``/``while``/``cond``) is ALSO
+autocast: the op is rebuilt through the public ``lax.scan`` /
+``while_loop`` / ``switch`` API with the body re-interpreted under this
+autocast and its outputs restored to the traced dtypes at the carry
+boundary — so carry dtypes stay fixed across iterations while the dots
+INSIDE the body run at compute precision (apex O1 patches the functional
+surface everywhere, including inside loops).  Custom-derivative calls
 (``custom_jvp_call``/``custom_vjp_call``) are OPAQUE: inputs are restored
 to the traced dtypes and the call is re-bound through
 ``primitive.get_bind_params`` (the ``core.eval_jaxpr`` mechanism), so the
@@ -47,7 +51,8 @@ _RECURSE = {"pjit", "jit", "closed_call", "core_call", "remat", "remat2",
 _CUSTOM_CALL = {"custom_jvp_call", "custom_vjp_call",
                 "custom_jvp_call_jaxpr", "custom_vjp_call_jaxpr",
                 "custom_jvp_generic_call", "custom_lin"}
-_RESTORE_DTYPES = {"scan", "while", "cond"}
+# control flow is rebuilt via the public API with an autocast body
+_CONTROL_FLOW = {"scan", "while", "cond"}
 
 
 def _is_float(x) -> bool:
@@ -65,6 +70,64 @@ def _widest(vals):
     if not dts:
         return None
     return functools.reduce(jnp.promote_types, dts)
+
+
+def _restore_outs(outs, jaxpr):
+    """Cast interpreted outputs back to their traced dtypes — the carry /
+    branch-output boundary contract that keeps control-flow dtypes
+    stable while the interior runs autocast."""
+    return [_cast(o, var.aval.dtype) if _is_float(o) else o
+            for o, var in zip(outs, jaxpr.outvars)]
+
+
+def _closed_body(closed, compute_dtype):
+    """An eager function interpreting ``closed`` under autocast, outputs
+    restored to traced dtypes."""
+    def fn(*xs):
+        outs = _eval_jaxpr(closed.jaxpr, closed.consts, list(xs),
+                           compute_dtype)
+        return _restore_outs(outs, closed.jaxpr)
+    return fn
+
+
+def _rebuild_scan(params, invals, compute_dtype):
+    nc, ncar = params["num_consts"], params["num_carry"]
+    consts, init, xs = invals[:nc], invals[nc:nc + ncar], invals[nc + ncar:]
+    body = _closed_body(params["jaxpr"], compute_dtype)
+
+    def f(carry, x):
+        outs = body(*consts, *carry, *x)
+        return tuple(outs[:ncar]), tuple(outs[ncar:])
+
+    carry_out, ys = jax.lax.scan(f, tuple(init), tuple(xs),
+                                 length=params["length"],
+                                 reverse=params["reverse"],
+                                 unroll=params.get("unroll", 1))
+    return list(carry_out) + list(ys)
+
+
+def _rebuild_while(params, invals, compute_dtype):
+    cn, bn = params["cond_nconsts"], params["body_nconsts"]
+    cc, bc, init = invals[:cn], invals[cn:cn + bn], invals[cn + bn:]
+    cond_body = _closed_body(params["cond_jaxpr"], compute_dtype)
+    body_body = _closed_body(params["body_jaxpr"], compute_dtype)
+    out = jax.lax.while_loop(
+        lambda carry: cond_body(*cc, *carry)[0],
+        lambda carry: tuple(body_body(*bc, *carry)),
+        tuple(init))
+    return list(out)
+
+
+def _rebuild_cond(params, invals, compute_dtype):
+    idx, ops = invals[0], invals[1:]
+    branches = [_closed_body(b, compute_dtype) for b in params["branches"]]
+    out = jax.lax.switch(idx, [
+        (lambda *xs, _f=f: tuple(_f(*xs))) for f in branches], *ops)
+    return list(out)
+
+
+_REBUILD = {"scan": _rebuild_scan, "while": _rebuild_while,
+            "cond": _rebuild_cond}
 
 
 def _eval_jaxpr(jaxpr, consts, args, compute_dtype):
@@ -101,27 +164,30 @@ def _eval_jaxpr(jaxpr, consts, args, compute_dtype):
                       for v, var in zip(invals, inner_jaxpr.invars)]
             outvals = _eval_jaxpr(inner_jaxpr, inner_consts, invals,
                                   compute_dtype)
+        elif name in _CONTROL_FLOW:
+            # restore traced dtypes at the boundary, then rebuild the op
+            # through the public API with an autocast-interpreted body
+            # (outputs restored per iteration, so carry dtypes are stable)
+            invals = [_cast(v, var.aval.dtype) if _is_float(v) else v
+                      for v, var in zip(invals, eqn.invars)]
+            outvals = _REBUILD[name](params, invals, compute_dtype)
         else:
-            if name in _RESTORE_DTYPES:
-                invals = [_cast(v, var.aval.dtype) if _is_float(v) else v
-                          for v, var in zip(invals, eqn.invars)]
-            else:
-                kind = classify(eqn.primitive)
-                if kind == "whitelist" and all(map(_is_float, invals)):
-                    invals = [_cast(v, compute_dtype) for v in invals]
-                    # tracing with f32 inputs bakes preferred_element_type=
-                    # f32 into dot/conv params; O1 semantics want half out.
-                    # (Integer/quantized dots fall through untouched.)
-                    pet = params.get("preferred_element_type")
-                    if pet is not None and jnp.issubdtype(pet, jnp.floating):
-                        params = dict(params,
-                                      preferred_element_type=compute_dtype)
-                elif kind == "blacklist":
-                    invals = [_cast(v, jnp.float32) for v in invals]
-                elif kind == "promote":
-                    wide = _widest(invals)
-                    if wide is not None:
-                        invals = [_cast(v, wide) for v in invals]
+            kind = classify(eqn.primitive)
+            if kind == "whitelist" and all(map(_is_float, invals)):
+                invals = [_cast(v, compute_dtype) for v in invals]
+                # tracing with f32 inputs bakes preferred_element_type=
+                # f32 into dot/conv params; O1 semantics want half out.
+                # (Integer/quantized dots fall through untouched.)
+                pet = params.get("preferred_element_type")
+                if pet is not None and jnp.issubdtype(pet, jnp.floating):
+                    params = dict(params,
+                                  preferred_element_type=compute_dtype)
+            elif kind == "blacklist":
+                invals = [_cast(v, jnp.float32) for v in invals]
+            elif kind == "promote":
+                wide = _widest(invals)
+                if wide is not None:
+                    invals = [_cast(v, wide) for v in invals]
             outvals = eqn.primitive.bind(*invals, **params)
         if not eqn.primitive.multiple_results:
             outvals = [outvals]
