@@ -22,9 +22,17 @@ multi-tensor buckets the fused-optimizer engine already uses:
 axis, params replicated, grads device-varying (the per-device microbatch
 gradients — no prior allreduce needed, the scatter IS the reduction).
 The gathered params are replicated in value but conservatively
-device-varying in JAX's vma typing, so wrap the step with
-``shard_map(..., check_vma=False)`` (see ``tests/test_distributed_optimizers.py``
-for the full recipe).
+device-varying in JAX's vma typing, which requires
+``shard_map(..., check_vma=False)``.
+
+**Use :meth:`~_DistributedMixin.make_init` /
+:meth:`~_DistributedMixin.make_step` rather than wrapping by hand**: they
+own that ``check_vma=False`` region — validating the mesh axis, the
+stacked-gradient shapes, and the param/grad tree agreement loudly at
+trace time — and return jitted callables.  (Hand-wrapping remains
+supported for embedding the step inside a larger shard_map region, e.g.
+a full train step; ``tests/test_distributed_optimizers.py`` keeps the
+manual recipe covered.)
 """
 
 from __future__ import annotations
@@ -172,6 +180,103 @@ class _DistributedMixin:
                 new_p_leaves[i] = t.astype(p_leaves[i].dtype)
         new_params = jax.tree_util.tree_unflatten(treedef, new_p_leaves)
         return new_params, {"step": step_count, "buckets": new_buckets}
+
+    # -- owned shard_map region ---------------------------------------------
+
+    def _check_mesh(self, mesh):
+        ax = self.axis_name
+        if ax not in mesh.axis_names:
+            raise ValueError(
+                f"optimizer reduces over axis {ax!r} but the mesh has axes "
+                f"{tuple(mesh.axis_names)}; pass axis_name={ax!r} at "
+                "construction or build the mesh with that axis")
+        size = mesh.shape[ax]
+        if size != self.world_size:
+            raise ValueError(
+                f"optimizer was built with world_size={self.world_size} "
+                f"but mesh axis {ax!r} has size {size}; the ZeRO shards "
+                "must match the mesh")
+
+    def _check_stacked_grads(self, grads, params):
+        p_tree = jax.tree_util.tree_structure(params)
+        g_tree = jax.tree_util.tree_structure(grads)
+        if p_tree != g_tree:
+            raise ValueError(
+                f"grads tree {g_tree} does not match params tree {p_tree}")
+
+        def chk(path, g, p):
+            want = (self.world_size,) + p.shape
+            if g.shape != want:
+                raise ValueError(
+                    f"grad leaf {jax.tree_util.keystr(path)} has shape "
+                    f"{g.shape}, expected {want}: make_step takes STACKED "
+                    "per-device gradients (leading axis = the "
+                    f"{self.axis_name!r} mesh axis, one microbatch grad "
+                    "per device — the reduce-scatter inside the step IS "
+                    "the DDP reduction).  For grads already reduced or "
+                    "produced inside your own shard_map region, call "
+                    ".step there instead.")
+
+        jax.tree_util.tree_map_with_path(chk, grads, params)
+
+    def make_init(self, mesh):
+        """Jitted state init owning the ``check_vma=False`` shard_map
+        region; returns per-device ZeRO state shards laid out by
+        :meth:`state_specs`."""
+        from jax.sharding import PartitionSpec as P
+        self._check_mesh(mesh)
+
+        def init(params):
+            return jax.shard_map(
+                self.init, mesh=mesh, in_specs=(P(),),
+                out_specs=self.state_specs(params), check_vma=False)(params)
+
+        return jax.jit(init)
+
+    def make_step(self, mesh, donate=False):
+        """Jitted ZeRO step owning the ``check_vma=False`` shard_map
+        region (the API form of the recipe this module's docstring used
+        to hand users).
+
+        The returned callable is
+        ``step(grads, params, state, lr=None, grad_scale=1.0,
+        noop_flag=None) -> (new_params, new_state)`` where ``grads`` are
+        the STACKED per-device microbatch gradients: leading axis =
+        ``world_size`` (sharded over the optimizer's mesh axis), one
+        unreduced gradient per device — the step's reduce-scatter is the
+        gradient reduction.  Misuse (wrong mesh axis, unstacked grads,
+        mismatched trees) raises at trace time with a message naming the
+        offending leaf.  ``donate=True`` donates params+state buffers.
+        """
+        from jax.sharding import PartitionSpec as P
+        self._check_mesh(mesh)
+        ax = self.axis_name
+
+        def step(grads, params, state, lr=None, grad_scale=1.0,
+                 noop_flag=None):
+            self._check_stacked_grads(grads, params)
+            specs = self.state_specs(params)
+            g_specs = jax.tree_util.tree_map(lambda _: P(ax), grads)
+            lr_val = jnp.asarray(
+                self.defaults["lr"] if lr is None else lr, _f32)
+            gs_val = jnp.asarray(grad_scale, _f32)
+            # an explicit zero noop flag is the identity: the kernels'
+            # select keeps the updated values and step_count advances
+            noop = (jnp.zeros((), _f32) if noop_flag is None
+                    else jnp.reshape(jnp.asarray(noop_flag, _f32), ()))
+
+            def local(g, p, s, lr_, gs_, noop_):
+                g = jax.tree_util.tree_map(lambda x: x[0], g)
+                return self.step(g, p, s, lr=lr_, grad_scale=gs_,
+                                 noop_flag=noop_)
+
+            return jax.shard_map(
+                local, mesh=mesh,
+                in_specs=(g_specs, P(), specs, P(), P(), P()),
+                out_specs=(P(), specs), check_vma=False)(
+                    grads, params, state, lr_val, gs_val, noop)
+
+        return jax.jit(step, donate_argnums=(1, 2) if donate else ())
 
     # -- subclass hooks ------------------------------------------------------
 
