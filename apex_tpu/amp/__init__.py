@@ -3,6 +3,11 @@ from apex_tpu.amp.handle import scale_loss, unscale_step
 from apex_tpu.amp.interpreter import autocast
 from apex_tpu.amp.scaler import LossScaler, LossScaleState
 from apex_tpu.amp.lists import WHITELIST, BLACKLIST, PROMOTE
+# legacy pre-initialize surface (apex amp.py/opt.py/rnn_compat.py)
+from apex_tpu.amp.legacy import (init, half_function, float_function,
+                                 promote_function, register_half_function,
+                                 register_float_function,
+                                 register_promote_function)
 
 
 def master_params(optimizer, params, opt_state):
@@ -24,4 +29,12 @@ __all__ = [
     "WHITELIST",
     "BLACKLIST",
     "PROMOTE",
+    # legacy surface
+    "init",
+    "half_function",
+    "float_function",
+    "promote_function",
+    "register_half_function",
+    "register_float_function",
+    "register_promote_function",
 ]

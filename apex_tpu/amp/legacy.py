@@ -1,0 +1,231 @@
+"""Legacy pre-``initialize`` amp surface — TPU rebuild of
+``apex/amp/amp.py`` (the ``amp.init()`` + function-registry API),
+``apex/amp/opt.py`` (``OptimWrapper``) and ``apex/amp/rnn_compat.py``.
+
+Upstream this was the ORIGINAL amp API, kept importable after
+``amp.initialize`` superseded it; same deal here.  The pieces:
+
+* :func:`init` -> :class:`AmpHandle` — activates the registries and owns
+  the loss scaler.
+* :func:`half_function` / :func:`float_function` / :func:`promote_function`
+  — decorators casting a function's floating args to half / fp32 / the
+  widest input dtype (apex wrapped torch functions; here any jax-level
+  callable).
+* :func:`register_half_function` (etc.) — monkeypatch ``module.name`` in
+  place, restored by ``AmpHandle._deactivate()`` — the apex mechanism for
+  third-party libraries, verbatim (Python module attributes patch the
+  same way torch's did).
+* :class:`OptimWrapper` / ``handle.wrap_optimizer`` — the functional form
+  of apex's wrapped optimizer: ``step(grads, params, opt_state)`` fuses
+  unscale + overflow-skip + update + dynamic-scale adjustment via
+  :func:`apex_tpu.amp.handle.unscale_step`.
+* :mod:`rnn_compat <apex_tpu.amp.legacy>`: apex patched torch's cuDNN RNN
+  bindings so amp casts reached them; the RNN tier here is plain scan
+  cells that the O1 interpreter already descends into, so
+  :func:`whitelist_rnn_cells` is a validated no-op (kept for import
+  parity).
+
+Deviation (documented): ``with handle.scale_loss(loss, opt) as scaled:
+scaled.backward()`` imperatively mutates grads; functionally the scaled
+loss is RETURNED (use it inside your loss fn) and the unscale happens in
+``OptimWrapper.step`` — the same split ``apex_tpu.amp.handle`` uses.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.handle import unscale_step
+from apex_tpu.amp.scaler import LossScaler
+
+__all__ = [
+    "init", "half_function", "float_function", "promote_function",
+    "register_half_function", "register_float_function",
+    "register_promote_function", "AmpHandle", "NoOpHandle", "OptimWrapper",
+    "whitelist_rnn_cells", "has_old_rnns",
+]
+
+_HALF_DTYPE = jnp.bfloat16
+
+
+def _cast_tree(args, dtype):
+    def cast(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+    return jax.tree_util.tree_map(cast, args)
+
+
+def _widest(args):
+    dts = [x.dtype for x in jax.tree_util.tree_leaves(args)
+           if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)]
+    if not dts:
+        return None
+    return functools.reduce(jnp.promote_types, dts)
+
+
+def _casting_wrapper(fn: Callable, mode: str, half_dtype) -> Callable:
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        if mode == "half":
+            args, kwargs = _cast_tree(args, half_dtype), _cast_tree(
+                kwargs, half_dtype)
+        elif mode == "float":
+            args, kwargs = _cast_tree(args, jnp.float32), _cast_tree(
+                kwargs, jnp.float32)
+        else:                                        # promote
+            wide = _widest((args, kwargs))
+            if wide is not None:
+                args, kwargs = _cast_tree(args, wide), _cast_tree(
+                    kwargs, wide)
+        return fn(*args, **kwargs)
+
+    wrapped._amp_original = fn
+    return wrapped
+
+
+def half_function(fn: Callable) -> Callable:
+    """apex ``amp.half_function``: run ``fn`` with half-cast float args."""
+    return _casting_wrapper(fn, "half", _HALF_DTYPE)
+
+
+def float_function(fn: Callable) -> Callable:
+    """apex ``amp.float_function``: run ``fn`` with fp32-cast float args."""
+    return _casting_wrapper(fn, "float", _HALF_DTYPE)
+
+
+def promote_function(fn: Callable) -> Callable:
+    """apex ``amp.promote_function``: promote float args to the widest."""
+    return _casting_wrapper(fn, "promote", _HALF_DTYPE)
+
+
+# module-level registries staged by register_* and applied by init()
+# (apex semantics: registration must precede init)
+_PENDING: list = []
+
+
+def register_half_function(module: Any, name: str) -> None:
+    _PENDING.append((module, name, "half"))
+
+
+def register_float_function(module: Any, name: str) -> None:
+    _PENDING.append((module, name, "float"))
+
+
+def register_promote_function(module: Any, name: str) -> None:
+    _PENDING.append((module, name, "promote"))
+
+
+class NoOpHandle:
+    """``amp.init(enabled=False)``: every hook is the identity."""
+
+    is_active = False
+
+    @contextlib.contextmanager
+    def scale_loss(self, loss, optimizer=None):
+        yield loss
+
+    def wrap_optimizer(self, optimizer):
+        return OptimWrapper(optimizer, self)
+
+    def loss_scale(self):
+        return 1.0
+
+    def _deactivate(self):
+        pass
+
+
+class AmpHandle:
+    """apex ``amp_state``/``AmpHandle``: owns the scaler + applied patches."""
+
+    is_active = True
+
+    def __init__(self, loss_scale="dynamic", half_dtype=_HALF_DTYPE,
+                 verbose=False):
+        del verbose
+        self.half_dtype = half_dtype
+        self.scaler = LossScaler(loss_scale=loss_scale)
+        self.scaler_state = self.scaler.init()
+        self._patched: list = []
+        for module, name, mode in _PENDING:
+            orig = getattr(module, name)
+            setattr(module, name, _casting_wrapper(orig, mode, half_dtype))
+            self._patched.append((module, name, orig))
+        _PENDING.clear()
+
+    def loss_scale(self):
+        return float(self.scaler_state.loss_scale)
+
+    @contextlib.contextmanager
+    def scale_loss(self, loss, optimizer=None):
+        """Yields the SCALED loss (functional deviation documented in the
+        module docstring: take grads of the yielded value; unscaling
+        happens in ``OptimWrapper.step``)."""
+        yield loss * self.scaler_state.loss_scale.astype(
+            jnp.result_type(loss))
+
+    def wrap_optimizer(self, optimizer):
+        return OptimWrapper(optimizer, self)
+
+    def _deactivate(self):
+        """Restore every monkeypatched function (apex handle teardown)."""
+        for module, name, orig in self._patched:
+            setattr(module, name, orig)
+        self._patched.clear()
+
+
+class OptimWrapper:
+    """apex ``opt.py::OptimWrapper`` functionally: fused unscale +
+    overflow-skip + step + dynamic scale update on the handle's scaler."""
+
+    def __init__(self, optimizer, handle):
+        self.optimizer = optimizer
+        self.handle = handle
+
+    def step(self, grads, params, opt_state, *, lr=None):
+        if not self.handle.is_active:
+            return self.optimizer.step(grads, params, opt_state, lr=lr)
+        new_p, new_s, scaler_state, _ = unscale_step(
+            self.optimizer, grads, params, opt_state, self.handle.scaler,
+            self.handle.scaler_state, lr=lr)
+        # the handle is host-side state (apex kept it on the python
+        # object too); fine outside jit, donate-free inside
+        self.handle.scaler_state = scaler_state
+        return new_p, new_s
+
+
+def init(enabled: bool = True, loss_scale="dynamic",
+         half_dtype=_HALF_DTYPE, enable_caching: bool = True,
+         verbose: bool = False, allow_banned: bool = False):
+    """apex ``amp.init()`` — returns the active :class:`AmpHandle` (or the
+    no-op handle when disabled).  ``enable_caching``/``allow_banned`` are
+    accepted for signature parity; weight-cast caching is XLA's job here.
+    """
+    del enable_caching, allow_banned
+    if not enabled:
+        # consume staged registrations so they cannot leak into a later
+        # unrelated init() (apex: disabled init deactivates everything)
+        _PENDING.clear()
+        return NoOpHandle()
+    return AmpHandle(loss_scale=loss_scale, half_dtype=half_dtype,
+                     verbose=verbose)
+
+
+# -- rnn_compat -------------------------------------------------------------
+
+has_old_rnns = False    # apex detected pre-0.4 torch RNN internals
+
+
+def whitelist_rnn_cells(handle=None, verbose=False):
+    """apex ``rnn_compat.whitelist_rnn_cells``: patched torch's RNN cell
+    backends into the cast registry.  The TPU RNN tier
+    (:mod:`apex_tpu.RNN`) is scan cells built from whitelisted
+    primitives, which the O1 interpreter autocasts INSIDE the scan body
+    — there is nothing to patch, so this validates and returns."""
+    del handle, verbose
+    import apex_tpu.RNN  # noqa: F401  (surface exists => nothing to do)
