@@ -1,0 +1,132 @@
+"""Dependency-free docs builder — the fallback for environments without a
+sphinx wheel (like the TPU image this repo develops in).
+
+``python docs/build.py [outdir]`` renders:
+
+* every ``docs/source/*.md`` page into a minimal HTML shell (markdown is
+  embedded verbatim in a ``<pre>``-free readable layout — headings,
+  code fences and lists pass through as text; the goal is greppable,
+  linkable API/user docs without a renderer dependency), and
+* one generated API page per documented package
+  (``apex_tpu.{amp,optimizers,transformer,parallel}``) from live
+  introspection: public classes/functions with signatures and
+  docstrings — the same inventory sphinx autodoc would emit.
+
+When sphinx IS available, ``sphinx-build -b html docs docs/_build/html``
+uses ``docs/conf.py`` instead; ``tests/test_docs.py`` exercises
+whichever path the environment supports.
+"""
+
+from __future__ import annotations
+
+import html
+import inspect
+import pathlib
+import sys
+
+# runnable from anywhere: the repo root (one level up) must be importable
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+PACKAGES = ["apex_tpu.amp", "apex_tpu.optimizers", "apex_tpu.transformer",
+            "apex_tpu.parallel"]
+
+_PAGE = """<!doctype html>
+<html><head><meta charset="utf-8"><title>{title}</title>
+<style>
+ body {{ font-family: sans-serif; max-width: 56rem; margin: 2rem auto;
+        line-height: 1.5; padding: 0 1rem; }}
+ pre, code {{ background: #f6f8fa; }}
+ pre {{ padding: .75rem; overflow-x: auto; }}
+ h2 {{ border-bottom: 1px solid #ddd; padding-bottom: .2rem; }}
+ .sig {{ background: #f6f8fa; padding: .4rem .6rem; display: block;
+        font-family: monospace; white-space: pre-wrap; }}
+</style></head><body>
+<p><a href="index.html">index</a></p>
+{body}
+</body></html>
+"""
+
+
+def _md_page(path: pathlib.Path) -> str:
+    text = html.escape(path.read_text())
+    return f"<h1>{html.escape(path.stem)}</h1>\n<pre>{text}</pre>"
+
+
+def _doc(obj) -> str:
+    d = inspect.getdoc(obj) or ""
+    return f"<pre>{html.escape(d)}</pre>" if d else ""
+
+
+def _sig(obj) -> str:
+    try:
+        return html.escape(str(inspect.signature(obj)))
+    except (TypeError, ValueError):
+        return "(...)"
+
+
+def _api_page(modname: str) -> str:
+    import importlib
+
+    mod = importlib.import_module(modname)
+    names = getattr(mod, "__all__", None) or [
+        n for n in sorted(vars(mod)) if not n.startswith("_")]
+    parts = [f"<h1>{modname} API</h1>", _doc(mod)]
+    for name in names:
+        try:
+            obj = getattr(mod, name)
+        except AttributeError:
+            continue
+        if inspect.isclass(obj):
+            parts.append(f"<h2>class {name}</h2>"
+                         f"<span class='sig'>{name}{_sig(obj)}</span>"
+                         f"{_doc(obj)}")
+            for mname, meth in sorted(vars(obj).items()):
+                if mname.startswith("_") or not callable(meth):
+                    continue
+                parts.append(f"<h3>{name}.{mname}</h3>"
+                             f"<span class='sig'>{mname}{_sig(meth)}</span>"
+                             f"{_doc(meth)}")
+        elif callable(obj):
+            parts.append(f"<h2>{name}</h2>"
+                         f"<span class='sig'>{name}{_sig(obj)}</span>"
+                         f"{_doc(obj)}")
+        else:
+            parts.append(f"<h2>{name}</h2><p>constant "
+                         f"<code>{html.escape(repr(obj))}</code></p>")
+    return "\n".join(parts)
+
+
+def build(outdir: str = "docs/_build/fallback") -> list:
+    root = pathlib.Path(__file__).resolve().parent
+    out = pathlib.Path(outdir)
+    out.mkdir(parents=True, exist_ok=True)
+    written = []
+
+    links = []
+    for md in sorted((root / "source").glob("*.md")):
+        # the generated site index owns index.html; the user index page
+        # renders as overview.html so neither clobbers the other
+        stem = "overview" if md.stem == "index" else md.stem
+        page = out / f"{stem}.html"
+        page.write_text(_PAGE.format(title=stem, body=_md_page(md)))
+        written.append(page)
+        links.append(f'<li><a href="{stem}.html">{stem}</a></li>')
+    for pkg in PACKAGES:
+        slug = pkg.replace(".", "_")
+        page = out / f"{slug}.html"
+        page.write_text(_PAGE.format(title=pkg, body=_api_page(pkg)))
+        written.append(page)
+        links.append(f'<li><a href="{slug}.html">{pkg} API</a></li>')
+
+    index = out / "index.html"
+    index.write_text(_PAGE.format(
+        title="apex-tpu docs",
+        body="<h1>apex-tpu documentation</h1><ul>" + "\n".join(links)
+             + "</ul>"))
+    written.append(index)
+    return written
+
+
+if __name__ == "__main__":
+    pages = build(*sys.argv[1:2])
+    print(f"wrote {len(pages)} pages -> {pages[-1].parent}")

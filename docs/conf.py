@@ -1,0 +1,37 @@
+# Sphinx configuration for apex_tpu (reference: apex docs/source/conf.py,
+# a standard sphinx + autodoc project over .rst sources; here the sources
+# are MyST markdown and the API pages are autodoc-generated).
+#
+# Build:  sphinx-build -b html docs docs/_build/html
+# The environment this repo develops in has no sphinx wheel; the build is
+# exercised by tests/test_docs.py when sphinx is importable, and
+# docs/build.py provides a dependency-free fallback renderer.
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(".."))
+
+project = "apex-tpu"
+author = "apex-tpu contributors"
+release = "0.2.0"
+
+extensions = [
+    "sphinx.ext.autodoc",
+    "sphinx.ext.napoleon",
+    "sphinx.ext.viewcode",
+    "myst_parser",
+]
+
+source_suffix = {".rst": "restructuredtext", ".md": "markdown"}
+master_doc = "index"
+exclude_patterns = ["_build"]
+
+autodoc_member_order = "bysource"
+autodoc_typehints = "description"
+
+# keep the import side effects light: the library lazy-imports heavy
+# subpackages, but autodoc still needs jax importable
+autodoc_mock_imports = []
+
+html_theme = "alabaster"
