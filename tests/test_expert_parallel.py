@@ -290,7 +290,8 @@ class TestSwitchGPT:
         np.testing.assert_allclose(got, ref, rtol=1e-5)
 
     def test_moe_tp_divisibility_validated(self):
-        with pytest.raises(ValueError, match="divisible"):
+        with pytest.raises(ValueError,
+                           match="MoE ffn_hidden_size must be divisible"):
             self._cfg(ffn_hidden_size=30, tensor_parallel_size=4,
                       axis_name="model")
 
