@@ -257,24 +257,28 @@ class _DistributedMixin:
             self._check_stacked_grads(grads, params)
             specs = self.state_specs(params)
             g_specs = jax.tree_util.tree_map(lambda _: P(ax), grads)
-            lr_val = jnp.asarray(
-                self.defaults["lr"] if lr is None else lr, _f32)
+            # lr=None must REACH self.step as None — a concrete default
+            # would read as an explicit override in _hyper and stomp
+            # per-group lr settings
+            lr_args = () if lr is None else (jnp.asarray(lr, _f32),)
             gs_val = jnp.asarray(grad_scale, _f32)
             # an explicit zero noop flag is the identity: the kernels'
             # select keeps the updated values and step_count advances
             noop = (jnp.zeros((), _f32) if noop_flag is None
                     else jnp.reshape(jnp.asarray(noop_flag, _f32), ()))
 
-            def local(g, p, s, lr_, gs_, noop_):
+            def local(g, p, s, gs_, noop_, *lr_):
                 g = jax.tree_util.tree_map(lambda x: x[0], g)
-                return self.step(g, p, s, lr=lr_, grad_scale=gs_,
-                                 noop_flag=noop_)
+                return self.step(g, p, s,
+                                 lr=lr_[0] if lr_ else None,
+                                 grad_scale=gs_, noop_flag=noop_)
 
             return jax.shard_map(
                 local, mesh=mesh,
-                in_specs=(g_specs, P(), specs, P(), P(), P()),
+                in_specs=(g_specs, P(), specs, P(), P())
+                         + (P(),) * len(lr_args),
                 out_specs=(P(), specs), check_vma=False)(
-                    grads, params, state, lr_val, gs_val, noop)
+                    grads, params, state, gs_val, noop, *lr_args)
 
         return jax.jit(step, donate_argnums=(1, 2) if donate else ())
 
