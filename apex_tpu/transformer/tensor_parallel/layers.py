@@ -70,9 +70,14 @@ def linear_with_grad_accumulation_and_async_allreduce(
             x = M.gather_from_sequence_parallel_region(x, axis_name)
         else:
             x = M.copy_to_tensor_model_parallel_region(x, axis_name)
-    y = x @ weight.T
+    # compute at the ACTIVATION dtype (Megatron bf16 training keeps fp32
+    # params as masters; the GEMM runs half).  Without the cast a bf16
+    # activation silently promotes the whole GEMM to f32 — wrong dtype
+    # contract AND off the MXU's bf16 rate.  The astype's transpose casts
+    # the weight cotangent back to the param dtype automatically.
+    y = x @ weight.astype(x.dtype).T
     if bias is not None:
-        y = y + bias
+        y = y + bias.astype(y.dtype)
     return y
 
 
@@ -200,7 +205,9 @@ class RowParallelLinear:
     def __call__(self, params, x):
         if self.axis_name is not None and not self.input_is_parallel:
             x = M.scatter_to_tensor_model_parallel_region(x, self.axis_name)
-        y = x @ params["weight"].T
+        # activation-dtype GEMM (see
+        # linear_with_grad_accumulation_and_async_allreduce)
+        y = x @ params["weight"].astype(x.dtype).T
         if self.axis_name is not None:
             if self.sequence_parallel_enabled:
                 y = M.reduce_scatter_to_sequence_parallel_region(
@@ -212,7 +219,7 @@ class RowParallelLinear:
         if self.skip_bias_add:
             return y, bias
         if bias is not None:
-            y = y + bias
+            y = y + bias.astype(y.dtype)
         return y, None
 
     apply = __call__
