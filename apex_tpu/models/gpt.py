@@ -31,6 +31,15 @@ from apex_tpu.transformer import tensor_parallel as tp
 
 _f32 = jnp.float32
 
+# Dropout-stream strides: layer i / microbatch m walk the seed space at
+# large odd strides (bijective mod 2^32, int32 wraparound is fine) so a
+# caller advancing the base seed by +1 per training step can never land
+# on a neighboring layer's or microbatch's stream from another step —
+# with stride 1 ("seed + i"), step t+1 layer i would replay step t
+# layer i+1's mask exactly.
+_SEED_LAYER_STRIDE = 0x3C6EF35F
+_SEED_MB_STRIDE = 0x5BD1E995
+
 
 @dataclasses.dataclass
 class GPTConfig:
@@ -52,6 +61,7 @@ class GPTConfig:
     moe_aux_weight: float = 1e-2
     expert_axis: Optional[str] = None          # EP: experts sharded here
     expert_parallel_size: int = 1
+    attention_dropout: float = 0.0             # fused flash-kernel dropout
     remat: bool = False                        # jax.checkpoint each layer
     dtype: jnp.dtype = jnp.float32             # activation/compute dtype
     param_dtype: jnp.dtype = jnp.float32
@@ -80,6 +90,14 @@ class GPTConfig:
             raise ValueError(
                 "expert_axis requires n_experts > 0 (the axis shards "
                 "the MoE expert stacks)")
+        if not 0.0 <= self.attention_dropout < 1.0:
+            raise ValueError(
+                f"attention_dropout must be in [0, 1), got "
+                f"{self.attention_dropout}")
+        if self.attention_dropout > 0.0 and self.context_axis is not None:
+            raise ValueError(
+                "attention_dropout is not supported with context "
+                "parallelism (the ring/ulysses kernels take no dropout)")
 
     @property
     def head_dim(self):
@@ -112,7 +130,8 @@ class ParallelAttention:
         return {"qkv": self.qkv.init_params(k1),
                 "proj": self.proj.init_params(k2)}
 
-    def __call__(self, params, x, rope_cos=None, rope_sin=None):
+    def __call__(self, params, x, rope_cos=None, rope_sin=None,
+                 dropout_seed=None):
         cfg = self.cfg
         b = x.shape[0]
         qkv, _ = self.qkv(params["qkv"], x)      # (b, s, 3h/t)
@@ -142,7 +161,13 @@ class ParallelAttention:
                     else ulysses_attention)
             ctx = attn(q, k, v, cfg.context_axis, causal=True)
         else:
-            ctx = flash_attention(q, k, v, causal=True)
+            # train-time probability dropout stays on the fused O(s)
+            # path (counter-hash mask, ops/flash_attention.py); no seed
+            # (eval) means no dropout
+            rate = cfg.attention_dropout if dropout_seed is not None \
+                else 0.0
+            ctx = flash_attention(q, k, v, causal=True, dropout=rate,
+                                  dropout_seed=dropout_seed)
         ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, nh * cfg.head_dim)
         out, _ = self.proj(params["proj"], ctx)
         return out
@@ -226,13 +251,14 @@ class ParallelTransformerLayer:
                     self.post_attention_layernorm.init_params(),
                 "mlp": self.mlp.init_params(k2)}
 
-    def __call__(self, params, x, rope_cos=None, rope_sin=None):
+    def __call__(self, params, x, rope_cos=None, rope_sin=None,
+                 dropout_seed=None):
         # named scopes land in HLO metadata -> visible in xprof traces
         # (the reference's nvtx range annotations, SURVEY §5)
         with jax.named_scope("attention"):
             h = self.input_layernorm(params["input_layernorm"], x)
             x = x + self.attention(params["attention"], h, rope_cos,
-                                   rope_sin)
+                                   rope_sin, dropout_seed)
         with jax.named_scope("mlp"):
             h = self.post_attention_layernorm(
                 params["post_attention_layernorm"], x)
@@ -291,7 +317,7 @@ class GPTModel:
             x = x + pe
         return x.astype(self.cfg.dtype)
 
-    def backbone(self, params, x, seq_len=None):
+    def backbone(self, params, x, seq_len=None, dropout_seed=None):
         local = seq_len or x.shape[1]
         if self.cfg.context_axis is not None:
             # rope positions are GLOBAL: build full tables, take the shard
@@ -301,21 +327,30 @@ class GPTModel:
                 off = self._seq_offset(local)
                 cos = jax.lax.dynamic_slice_in_dim(cos, off, local)
                 sin = jax.lax.dynamic_slice_in_dim(sin, off, local)
-            return self._backbone_layers(params, x, cos, sin)
+            return self._backbone_layers(params, x, cos, sin, dropout_seed)
         cos, sin = self.rope_tables(local)
-        return self._backbone_layers(params, x, cos, sin)
+        return self._backbone_layers(params, x, cos, sin, dropout_seed)
 
-    def _backbone_layers(self, params, x, cos, sin):
-        """Returns ``(x, moe_aux_total)`` (aux is 0.0 for dense FFNs)."""
+    def _backbone_layers(self, params, x, cos, sin, dropout_seed=None):
+        """Returns ``(x, moe_aux_total)`` (aux is 0.0 for dense FFNs).
+
+        ``dropout_seed`` (train-time attention dropout): layer ``i`` uses
+        ``dropout_seed + i * _SEED_LAYER_STRIDE`` — the same per-layer
+        stream walk the pipeline stage_fn reproduces by carrying a
+        striding seed.  Advance the base seed by +1 per training step.
+        """
         aux_total = jnp.zeros((), _f32)
-        for layer, lp in zip(self.layers, params["layers"]):
+        for li, (layer, lp) in enumerate(zip(self.layers,
+                                             params["layers"])):
+            seed = (None if dropout_seed is None
+                    else dropout_seed + li * _SEED_LAYER_STRIDE)
             call = layer
             if self.cfg.remat:
                 # trade recompute for activation memory (apex
                 # tensor_parallel.checkpoint → jax.checkpoint)
                 call = jax.checkpoint(
-                    lambda lp, x, c, s, _l=layer: _l(lp, x, c, s))
-            out = call(lp, x, cos, sin)
+                    lambda lp, x, c, s, sd, _l=layer: _l(lp, x, c, s, sd))
+            out = call(lp, x, cos, sin, seed)
             if layer.is_moe:
                 x, aux = out
                 aux_total = aux_total + aux
@@ -330,22 +365,28 @@ class GPTModel:
         return jnp.einsum("bsh,vh->bsv", x.astype(_f32),
                           w.astype(_f32))
 
-    def __call__(self, params, tokens):
+    def __call__(self, params, tokens, dropout_seed=None):
         x = self.embed(params, tokens)
-        x, _ = self.backbone(params, x)
+        x, _ = self.backbone(params, x, dropout_seed=dropout_seed)
         return self.logits(params, x)
 
     apply = __call__
 
-    def loss(self, params, tokens, targets):
+    def loss(self, params, tokens, targets, dropout_seed=None):
         """Mean next-token loss via vocab-parallel cross entropy (+ the
         Switch aux load-balancing term when the FFNs are MoE).
 
         Under context parallelism the mean over local tokens is pmeaned
         across the context axis (equal shard sizes -> exact global mean).
+
+        ``dropout_seed`` (int or traced scalar) enables the configured
+        ``attention_dropout`` for this step — pass the step counter
+        (advance by +1 per step; layer/microbatch streams stride the
+        seed space so steps never replay each other's masks); omit it
+        (None) for eval.
         """
         x = self.embed(params, tokens)
-        x, aux = self.backbone(params, x)
+        x, aux = self.backbone(params, x, dropout_seed=dropout_seed)
         logits = self.logits(params, x)
         b, s, vl = logits.shape
         per = tp.vocab_parallel_cross_entropy(
@@ -583,47 +624,55 @@ def stack_layers_for_pipeline(layer_params, n_stages: int):
         stacked)
 
 
-def make_stage_fn(model: GPTModel):
+def make_stage_fn(model: GPTModel, with_dropout_seed: bool = False):
     """Build the pipeline ``stage_fn``: scan this stage's stacked layer
     params over the activation (``(mb, s, h) -> (mb, s, h)``).
 
-    For MoE models the stage activation is the pair ``(x, aux)``: the
-    Switch aux loss rides the pipeline carry with the activation
-    (ppermuted stage-to-stage as a scalar), each stage adding its local
-    layers' contributions, so the last stage holds the per-microbatch
-    total the loss term needs."""
+    The stage activation is ``x`` or a tuple riding extra scalars on the
+    pipeline carry (ppermuted stage-to-stage with the activation):
+
+    * MoE models: ``aux`` — each stage adds its local layers' Switch aux
+      contributions, so the last stage holds the per-microbatch total.
+    * ``with_dropout_seed``: ``seed`` — the attention-dropout stream,
+      incremented once per layer, so layer ``i`` of the pipeline uses
+      ``base_seed + i`` exactly like the serial backbone, with no
+      stage/virtual-chunk index arithmetic.
+
+    Tuple order: ``(x[, aux][, seed])``.
+    """
     layer = model.layers[0]       # all layers share the module config
+    moe = model.cfg.n_experts > 0
 
-    if model.cfg.n_experts > 0:
-        def moe_stage_fn(stage_params, carry):
-            x, aux = carry
-            cos, sin = model.rope_tables(x.shape[1])
-
-            def body(c, lp):
-                h, a = c
-                y, la = layer(lp, h, cos, sin)
-                return (y, a + la.astype(a.dtype)), None
-
-            out, _ = jax.lax.scan(body, (x, aux), stage_params)
-            return out
-
-        return moe_stage_fn
-
-    def stage_fn(stage_params, x):
+    def stage_fn(stage_params, carry):
+        parts = list(carry) if isinstance(carry, tuple) else [carry]
+        x = parts[0]
+        aux = parts[1] if moe else None
+        seed = parts[-1] if with_dropout_seed else None
         cos, sin = model.rope_tables(x.shape[1])
 
-        def body(h, lp):
-            return layer(lp, h, cos, sin), None
+        def body(c, lp):
+            h, a, sd = c
+            out = layer(lp, h, cos, sin, sd)
+            if moe:
+                y, la = out
+                a = a + la.astype(a.dtype)
+            else:
+                y = out
+            return (y, a,
+                    None if sd is None else sd + _SEED_LAYER_STRIDE), None
 
-        y, _ = jax.lax.scan(body, x, stage_params)
-        return y
+        (y, a, sd), _ = jax.lax.scan(body, (x, aux, seed), stage_params)
+        outs = [y] + ([a] if moe else []) + ([sd] if with_dropout_seed
+                                             else [])
+        return tuple(outs) if len(outs) > 1 else outs[0]
 
     return stage_fn
 
 
 def pipeline_loss(model: GPTModel, params, tokens, targets, *,
                   pipe_axis: str = "pipe", data_axis: Optional[str] = None,
-                  n_virtual: int = 1, remat: bool = False):
+                  n_virtual: int = 1, remat: bool = False,
+                  dropout_seed=None):
     """GPT training loss over the SPMD pipeline — call inside ``shard_map``.
 
     ``params["layers"]`` holds this stage's stacked layers (leaves
@@ -666,18 +715,33 @@ def pipeline_loss(model: GPTModel, params, tokens, targets, *,
     params = jax.tree_util.tree_map(_vary, params)
 
     moe = model.cfg.n_experts > 0
+    with_seed = (model.cfg.attention_dropout > 0.0
+                 and dropout_seed is not None)
     x = _vary(jax.vmap(lambda t: model.embed(params, t))(tokens))
+    parts = [x]
     if moe:
         # aux rides the pipeline with the activation (one scalar per
         # microbatch, starting at 0 on entry to stage 0)
-        x = (x, _vary(jnp.zeros((tokens.shape[0],), _f32)))
-    outs = spmd_pipeline(make_stage_fn(model), params["layers"], x,
-                         axis_name=pipe_axis, n_virtual=n_virtual,
-                         remat=remat)
+        parts.append(_vary(jnp.zeros((tokens.shape[0],), _f32)))
+    if with_seed:
+        # per-microbatch base seeds strided by _SEED_MB_STRIDE; the stage
+        # scan strides by _SEED_LAYER_STRIDE per layer, so microbatch m's
+        # layer i draws stream base + m*MB + i*LAYER — distinct from
+        # every other (m, i) pair AND from every small per-step advance
+        # of the base seed
+        M = tokens.shape[0]
+        parts.append(_vary(jnp.asarray(dropout_seed, jnp.int32)
+                           + jnp.arange(M, dtype=jnp.int32)
+                           * jnp.int32(_SEED_MB_STRIDE)))
+    x = tuple(parts) if len(parts) > 1 else x
+    outs = spmd_pipeline(make_stage_fn(model, with_dropout_seed=with_seed),
+                         params["layers"], x, axis_name=pipe_axis,
+                         n_virtual=n_virtual, remat=remat)
 
     def head(y, t):
-        if moe:
-            y, aux = y
+        if isinstance(y, tuple):
+            aux = y[1] if moe else None
+            y = y[0]
         logits = model.logits(params, y)
         mb, s, vl = logits.shape
         per = tp.vocab_parallel_cross_entropy(
