@@ -94,3 +94,11 @@ class TestExamples:
              "--steps", "2", "--print-freq", "1",
              "--opt-level", opt_level]))
         assert "devices=8" in out
+
+    def test_gpt7b_recipe_smoke(self):
+        """BASELINE row 2's runnable artifact: the 7B TP x PP recipe at
+        --smoke keeps the full tp=2 x pp=2 x dp=2 topology and every
+        collective family, shrinking only shapes."""
+        out = _check(_run_example(
+            "examples/gpt7b/pretrain_gpt7b.py", ["--smoke", "--steps", "2"]))
+        assert "mesh=(dp=2, pp=2, tp=2)" in out
