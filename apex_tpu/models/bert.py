@@ -23,6 +23,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.models.gpt import _remat_policy
 from apex_tpu.normalization import MixedFusedLayerNorm
 from apex_tpu.ops.flash_attention import flash_attention
 from apex_tpu.transformer import tensor_parallel as tp
@@ -43,12 +44,17 @@ class BertConfig:
     axis_name: Optional[str] = None
     sequence_parallel: bool = False
     remat: bool = False
+    remat_policy: str = "full"                 # "full" | "dots" (selective)
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
 
     def __post_init__(self):
         if self.ffn_hidden_size is None:
             self.ffn_hidden_size = 4 * self.hidden_size
+        if self.remat_policy not in ("full", "dots"):
+            raise ValueError(
+                f"remat_policy must be 'full' or 'dots', got "
+                f"{self.remat_policy!r}")
         if self.hidden_size % self.num_attention_heads:
             raise ValueError(
                 "hidden_size must be divisible by num_attention_heads")
@@ -191,7 +197,8 @@ class BertModel:
         for layer, lp in zip(self.layers, params["layers"]):
             if cfg.remat:
                 x = jax.checkpoint(
-                    lambda lp, x, sl, _l=layer: _l(lp, x, sl))(
+                    lambda lp, x, sl, _l=layer: _l(lp, x, sl),
+                    policy=_remat_policy(cfg.remat_policy))(
                         lp, x, seqlens)
             else:
                 x = layer(lp, x, seqlens)

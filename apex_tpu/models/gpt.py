@@ -41,6 +41,13 @@ _SEED_LAYER_STRIDE = 0x3C6EF35F
 _SEED_MB_STRIDE = 0x5BD1E995
 
 
+def _remat_policy(name: str):
+    """jax.checkpoint policy for a GPTConfig.remat_policy name."""
+    if name == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    return None                                  # "full": save nothing
+
+
 @dataclasses.dataclass
 class GPTConfig:
     vocab_size: int = 50304
@@ -63,6 +70,7 @@ class GPTConfig:
     expert_parallel_size: int = 1
     attention_dropout: float = 0.0             # fused flash-kernel dropout
     remat: bool = False                        # jax.checkpoint each layer
+    remat_policy: str = "full"                 # "full" | "dots" (selective)
     dtype: jnp.dtype = jnp.float32             # activation/compute dtype
     param_dtype: jnp.dtype = jnp.float32
 
@@ -98,6 +106,10 @@ class GPTConfig:
             raise ValueError(
                 "attention_dropout is not supported with context "
                 "parallelism (the ring/ulysses kernels take no dropout)")
+        if self.remat_policy not in ("full", "dots"):
+            raise ValueError(
+                f"remat_policy must be 'full' or 'dots', got "
+                f"{self.remat_policy!r}")
 
     @property
     def head_dim(self):
@@ -347,9 +359,14 @@ class GPTModel:
             call = layer
             if self.cfg.remat:
                 # trade recompute for activation memory (apex
-                # tensor_parallel.checkpoint → jax.checkpoint)
+                # tensor_parallel.checkpoint → jax.checkpoint).
+                # remat_policy="dots" is Megatron's SELECTIVE activation
+                # recompute: GEMM outputs are saved (the expensive MXU
+                # work is not redone in the backward), only the cheap
+                # elementwise/softmax chain recomputes
                 call = jax.checkpoint(
-                    lambda lp, x, c, s, sd, _l=layer: _l(lp, x, c, s, sd))
+                    lambda lp, x, c, s, sd, _l=layer: _l(lp, x, c, s, sd),
+                    policy=_remat_policy(self.cfg.remat_policy))
             out = call(lp, x, cos, sin, seed)
             if layer.is_moe:
                 x, aux = out
@@ -671,7 +688,7 @@ def make_stage_fn(model: GPTModel, with_dropout_seed: bool = False):
 
 def pipeline_loss(model: GPTModel, params, tokens, targets, *,
                   pipe_axis: str = "pipe", data_axis: Optional[str] = None,
-                  n_virtual: int = 1, remat: bool = False,
+                  n_virtual: int = 1, remat: Optional[bool] = None,
                   dropout_seed=None):
     """GPT training loss over the SPMD pipeline — call inside ``shard_map``.
 
@@ -734,9 +751,15 @@ def pipeline_loss(model: GPTModel, params, tokens, targets, *,
                            + jnp.arange(M, dtype=jnp.int32)
                            * jnp.int32(_SEED_MB_STRIDE)))
     x = tuple(parts) if len(parts) > 1 else x
+    # remat defaults to the model config (a cfg.remat=True model must not
+    # silently lose rematerialization under the pipeline engine), and the
+    # selective policy composes with the stage checkpoint
+    if remat is None:
+        remat = model.cfg.remat
     outs = spmd_pipeline(make_stage_fn(model, with_dropout_seed=with_seed),
                          params["layers"], x, axis_name=pipe_axis,
-                         n_virtual=n_virtual, remat=remat)
+                         n_virtual=n_virtual, remat=remat,
+                         remat_policy=_remat_policy(model.cfg.remat_policy))
 
     def head(y, t):
         if isinstance(y, tuple):

@@ -39,7 +39,7 @@ def _ring_perm(n):
 
 def spmd_pipeline(stage_fn: Callable, params, microbatches, *,
                   axis_name: str = PIPELINE_AXIS, n_virtual: int = 1,
-                  remat: bool = False):
+                  remat: bool = False, remat_policy=None):
     """Run ``M`` microbatches through an ``S``(×``v``)-stage pipeline.
 
     Must be called inside ``shard_map`` with ``axis_name`` in scope.
@@ -73,7 +73,9 @@ def spmd_pipeline(stage_fn: Callable, params, microbatches, *,
     T = M + L - 1
 
     if remat:
-        stage_fn = jax.checkpoint(stage_fn)
+        # remat_policy: jax.checkpoint policy (e.g. dots saveable for
+        # Megatron-style SELECTIVE activation recompute); None = full
+        stage_fn = jax.checkpoint(stage_fn, policy=remat_policy)
 
     def run_chunks(params, x):
         # x leaves: (v, mb...) — chunk c's incoming activation
@@ -149,7 +151,8 @@ def last_stage_mean_loss(loss_fn, outs, targets, axis_name):
 
 def pipeline_value_and_grad(stage_fn, loss_fn, params, microbatches,
                             targets, *, axis_name: str = PIPELINE_AXIS,
-                            n_virtual: int = 1, remat: bool = False):
+                            n_virtual: int = 1, remat: bool = False,
+                            remat_policy=None):
     """Forward+backward through the pipeline; the workhorse under the apex
     ``forward_backward_pipelining_*`` schedule functions.
 
@@ -161,7 +164,7 @@ def pipeline_value_and_grad(stage_fn, loss_fn, params, microbatches,
     def total_loss(params):
         outs = spmd_pipeline(stage_fn, params, microbatches,
                              axis_name=axis_name, n_virtual=n_virtual,
-                             remat=remat)
+                             remat=remat, remat_policy=remat_policy)
         return last_stage_mean_loss(loss_fn, outs, targets, axis_name)
 
     return jax.value_and_grad(total_loss)(params)

@@ -113,6 +113,7 @@ def main():
     def grad_step(sp, tokens, targets):
         tk = tokens.reshape(M, mb, seq)
         tg = targets.reshape(M, mb, seq)
+        # remat follows cfg.remat=True (per-layer stage checkpoint)
         loss, g = jax.value_and_grad(
             lambda p: pipeline_loss(par, p, tk, tg, pipe_axis="pipe",
                                     data_axis="data"))(local_fn(sp))
