@@ -279,3 +279,122 @@ class TestXlaFusionClaim:
                                             x).compile().as_text()
         strays = self._entry_strays(hlo)
         assert not strays, f"unfused entry ops: {strays}"
+
+
+class TestRound4SurfacesOnChip:
+    """Round-4 surfaces on the real chip: fused flash dropout (compiled
+    Mosaic incl. the uint32 counter-hash), selective remat, GPT dropout
+    end-to-end, bf16 TP GEMM dtype, and the big-bucket bf16 packing that
+    OOMed compile before the per-leaf reshape fix."""
+
+    def test_flash_dropout_parity_and_determinism(self, rng):
+        from apex_tpu.ops.flash_attention import (
+            dropout_keep_scale, flash_attention, flash_attention_reference)
+
+        b, h, s, d = 2, 4, 256, 64
+        q = jnp.asarray(rng.randn(b, h, s, d).astype(np.float32))
+        k = jnp.asarray(rng.randn(b, h, s, d).astype(np.float32))
+        v = jnp.asarray(rng.randn(b, h, s, d).astype(np.float32))
+        rate, seed = 0.2, 321
+        out = flash_attention(q, k, v, causal=True, dropout=rate,
+                              dropout_seed=seed)
+        mask = dropout_keep_scale(seed, b * h, s, s,
+                                  rate).reshape(b, h, s, s)
+        ref = flash_attention_reference(q, k, v, causal=True,
+                                        dropout_mask=mask)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-2, atol=2e-2)  # MXU f32 tol
+        again = flash_attention(q, k, v, causal=True, dropout=rate,
+                                dropout_seed=seed)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(again))
+        # backward compiles and is finite with the regenerated mask
+        g = jax.jit(jax.grad(lambda q: flash_attention(
+            q, k, v, causal=True, dropout=rate,
+            dropout_seed=seed).astype(jnp.float32).sum()))(q)
+        assert bool(jnp.all(jnp.isfinite(g)))
+
+    def test_gpt_dropout_train_step(self, rng):
+        from apex_tpu.models.gpt import GPTConfig, GPTModel
+        from apex_tpu.optimizers import FusedAdam
+
+        cfg = GPTConfig(vocab_size=512, hidden_size=256, num_layers=2,
+                        num_attention_heads=4, max_seq_len=128,
+                        attention_dropout=0.1, dtype=jnp.bfloat16)
+        model = GPTModel(cfg)
+        params = model.init_params(jax.random.PRNGKey(0))
+        adam = FusedAdam(lr=1e-3)
+        state = adam.init(params)
+        tokens = jnp.asarray(rng.randint(0, 512, (4, 128)))
+
+        @jax.jit
+        def step(params, state, seed):
+            loss, g = jax.value_and_grad(model.loss)(
+                params, tokens, tokens, dropout_seed=seed)
+            params, state = adam.step(g, params, state)
+            return loss, params, state
+
+        losses = []
+        for i in range(4):
+            loss, params, state = step(params, state, jnp.int32(i))
+            losses.append(float(loss))
+        assert all(np.isfinite(losses)), losses
+
+    def test_selective_remat_compiles_and_matches(self, rng):
+        from apex_tpu.models.gpt import GPTConfig, GPTModel
+
+        kw = dict(vocab_size=512, hidden_size=256, num_layers=2,
+                  num_attention_heads=4, max_seq_len=128, remat=True,
+                  dtype=jnp.bfloat16)
+        tokens = jnp.asarray(rng.randint(0, 512, (4, 128)))
+        out = {}
+        for pol in ("full", "dots"):
+            m = GPTModel(GPTConfig(remat_policy=pol, **kw))
+            p = m.init_params(jax.random.PRNGKey(0))
+            # the policy only changes the BACKWARD (which residuals are
+            # saved vs recomputed) — grads are the real comparison
+            loss, g = jax.jit(jax.value_and_grad(m.loss))(p, tokens,
+                                                          tokens)
+            out[pol] = (float(loss), g)
+        np.testing.assert_allclose(out["full"][0], out["dots"][0],
+                                   rtol=1e-3)
+        for a, b in zip(jax.tree_util.tree_leaves(out["full"][1]),
+                        jax.tree_util.tree_leaves(out["dots"][1])):
+            np.testing.assert_allclose(np.asarray(a, np.float32),
+                                       np.asarray(b, np.float32),
+                                       rtol=2e-2, atol=2e-2)
+
+    def test_tp_linear_bf16_gemm_dtype(self, rng):
+        """The serial TP linear must emit a bf16 dot for bf16 activations
+        (the round-4 dtype-contract fix) — checked in the optimized HLO."""
+        from apex_tpu.transformer import tensor_parallel as tp
+
+        lin = tp.ColumnParallelLinear(256, 512, axis_name=None)
+        params = lin.init_params(jax.random.PRNGKey(0))
+        x = jnp.asarray(rng.randn(8, 256), jnp.bfloat16)
+        hlo = jax.jit(lambda p, x: lin(p, x)[0]).lower(params, x)\
+            .compile().as_text()
+        # the dot/convolution op itself must produce bf16 (not merely
+        # mention bf16 somewhere — the input declaration already does);
+        # a silent f32 promotion would emit "f32[...] dot|convolution"
+        import re
+        ops = re.findall(r"(\w+)\[[^\]]*\]\S* (?:dot|convolution)\(", hlo)
+        assert ops and all(o == "bf16" for o in ops), (ops, hlo[:500])
+        out, _ = jax.jit(lambda p, x: lin(p, x))(params, x)
+        assert out.dtype == jnp.bfloat16
+
+    def test_large_bf16_bucket_flatten_unflatten(self, rng):
+        """~50M-element bf16 bucket round-trips through the packing (the
+        pre-fix concat-then-reshape compile would OOM at this scale on
+        larger models; per-leaf packing must stay layout-safe)."""
+        from apex_tpu.multi_tensor_apply import bucketing as B
+
+        shapes = [(4096, 4096), (4096,), (4096, 4096), (16384, 1024),
+                  (1000, 333)]
+        meta = B.bucket_meta(shapes, jnp.bfloat16)
+        leaves = [jnp.asarray(rng.randn(*s).astype(np.float32),
+                              jnp.bfloat16) for s in shapes]
+        packed = jax.jit(lambda ls: B.flatten_bucket(ls, meta))(leaves)
+        assert packed.shape == (meta.nrows, 128)
+        outs = jax.jit(lambda p: B.unflatten_bucket(p, meta))(packed)
+        for a, b in zip(outs, leaves):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
