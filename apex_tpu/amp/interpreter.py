@@ -203,6 +203,13 @@ def autocast(fun, compute_dtype=jnp.bfloat16):
     output dtypes EXCEPT where the final op itself was reclassified (matmul
     outputs become ``compute_dtype``), mirroring apex O1 where patched ops
     return fp16 tensors.
+
+    Distributed composition: apply autocast to the PER-DEVICE function
+    and wrap the result in ``shard_map`` — tracing happens inside the
+    region, so collectives (``psum``/``pmean``/…) pass through and grads
+    compose (apex: O1 patches compose with DDP the same way, model first,
+    wrapper outside).  Covered by
+    ``tests/test_amp.py::TestAutocastO1::test_autocast_inside_shard_map``.
     """
 
     @functools.wraps(fun)

@@ -198,6 +198,45 @@ class TestAutocastO1:
         assert all(np.all(np.isfinite(np.asarray(l)))
                    for l in jax.tree_util.tree_leaves(g))
 
+    def test_autocast_inside_shard_map(self):
+        """O1 x DDP composition: autocast the per-device function, wrap
+        in shard_map — collectives pass through, grads compose, and the
+        interior dots run bf16."""
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        mesh = jax.make_mesh((jax.device_count(),), ("data",))
+        w = jnp.full((16, 16), 0.1, jnp.float32)
+        x = jnp.ones((jax.device_count() * 2, 16), jnp.float32)
+
+        def loss(w, x):
+            h = jnp.tanh(x @ w)
+            return jax.lax.pmean(jnp.sum(h), "data")
+
+        ac = amp.autocast(loss, compute_dtype=jnp.bfloat16)
+        sm = shard_map(ac, mesh=mesh, in_specs=(P(), P("data")),
+                       out_specs=P())
+        ref = float(jax.jit(shard_map(
+            loss, mesh=mesh, in_specs=(P(), P("data")),
+            out_specs=P()))(w, x))
+        out = float(jax.jit(sm)(w, x))
+        assert abs(out - ref) < 1e-2 * max(abs(ref), 1.0)
+        hlo = jax.jit(sm).lower(w, x).as_text()
+        assert any("bf16" in l for l in hlo.splitlines()
+                   if "dot_general" in l), "dot stayed fp32 in the region"
+        def grad_of(fn):
+            return jax.jit(shard_map(
+                lambda w, x: jax.grad(lambda w: fn(w, x))(w), mesh=mesh,
+                in_specs=(P(), P("data")), out_specs=P()))(w, x)
+
+        g = grad_of(ac)
+        assert g.dtype == jnp.float32
+        # the composition claim is numeric: autocast grads must track the
+        # un-autocast shard_map grads (same pmean transpose/psum wiring)
+        np.testing.assert_allclose(np.asarray(g),
+                                   np.asarray(grad_of(loss)),
+                                   rtol=2e-2, atol=2e-2)
+
     def test_composite_network_numerics(self):
         # autocast output should approximate the f32 reference
         def net(params, x):
