@@ -40,8 +40,12 @@ def save_numpy(path: str, arr, threads: int = 4) -> None:
     # a stale pytree sidecar would flip load()'s format dispatch
     if os.path.exists(path + ".json"):
         os.unlink(path + ".json")
-    a = np.ascontiguousarray(_tohost(arr))
-    hdr = json.dumps({"dtype": a.dtype.str, "shape": list(a.shape)}).encode()
+    host = _tohost(arr)
+    a = np.ascontiguousarray(host)
+    # record host.shape, not a.shape: ascontiguousarray promotes 0-d
+    # scalars to 1-d, which would round-trip () as (1,)
+    hdr = json.dumps({"dtype": a.dtype.str,
+                      "shape": list(host.shape)}).encode()
     payload = np.empty((len(_MAGIC) + 4 + len(hdr) + a.nbytes,), np.uint8)
     payload[:4] = np.frombuffer(_MAGIC, np.uint8)
     payload[4:8] = np.frombuffer(struct.pack("<I", len(hdr)), np.uint8)
@@ -65,11 +69,14 @@ def save_pytree(path: str, tree, threads: int = 4) -> None:
     import jax
 
     leaves, treedef = jax.tree_util.tree_flatten(tree)
-    arrs = [np.ascontiguousarray(_tohost(x)) for x in leaves]
+    hosts = [_tohost(x) for x in leaves]
+    arrs = [np.ascontiguousarray(h) for h in hosts]
+    # shapes from the ORIGINAL host arrays: ascontiguousarray promotes
+    # 0-d scalars to 1-d, which would round-trip () as (1,)
     manifest = {
         "treedef": str(treedef),
-        "leaves": [{"dtype": a.dtype.str, "shape": list(a.shape)}
-                   for a in arrs],
+        "leaves": [{"dtype": a.dtype.str, "shape": list(h.shape)}
+                   for a, h in zip(arrs, hosts)],
     }
     packed = native.pack(arrs)
     native.file_write(path, packed, threads=threads)

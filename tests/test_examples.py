@@ -102,3 +102,26 @@ class TestExamples:
         out = _check(_run_example(
             "examples/gpt7b/pretrain_gpt7b.py", ["--smoke", "--steps", "2"]))
         assert "mesh=(dp=2, pp=2, tp=2)" in out
+
+    def test_checkpoint_resume_bitwise(self, tmp_path):
+        """SURVEY §5 checkpoint/resume: the resumed process continues the
+        EXACT trajectory of the uninterrupted run — full state (params,
+        packed optimizer buckets, dynamic scaler, step) round-trips
+        through the framework's own parallel-IO runtime."""
+        import re
+        ck = str(tmp_path / "ck.bin")
+        full = _check(_run_example(
+            "examples/checkpoint/train_resume.py",
+            ["--steps", "6", "--save-at", "3", "--ckpt", ck]))
+        resumed = _check(_run_example(
+            "examples/checkpoint/train_resume.py",
+            ["--steps", "6", "--resume", "--ckpt", ck]))
+
+        def losses(out):
+            return {int(m[0]): m[1] for m in
+                    re.findall(r"step (\d+): loss=([0-9.]+)", out)}
+
+        lf, lr = losses(full), losses(resumed)
+        assert set(lr) == {3, 4, 5}, resumed
+        for s in lr:
+            assert lf[s] == lr[s], (s, lf[s], lr[s])  # bitwise identical

@@ -125,3 +125,23 @@ class TestGDS:
         p = str(tmp_path / "jx.apxt")
         gds.save(p, a)
         np.testing.assert_array_equal(gds.load(p), np.asarray(a))
+
+
+def test_gds_scalar_leaves_roundtrip(tmp_path):
+    """0-d leaves must round-trip as 0-d: np.ascontiguousarray promotes
+    scalars to 1-d, which used to corrupt optimizer step counters and
+    scaler state in checkpoints (caught by the resume recipe)."""
+    import jax.numpy as jnp
+
+    from apex_tpu.contrib import gpu_direct_storage as gds
+
+    obj = {"a": jnp.zeros((3, 4)), "step": jnp.int32(7),
+           "scale": jnp.float32(2.5)}
+    path = str(tmp_path / "scalars.bin")
+    gds.save(path, obj)
+    back = gds.load(path, tree_like=obj)
+    assert np.asarray(back["step"]).shape == ()
+    assert np.asarray(back["scale"]).shape == ()
+    assert int(back["step"]) == 7 and float(back["scale"]) == 2.5
+    np.testing.assert_array_equal(np.asarray(back["a"]),
+                                  np.asarray(obj["a"]))
