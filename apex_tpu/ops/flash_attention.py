@@ -121,17 +121,11 @@ def dropout_keep_scale(seed, n_bh, sq, sk, rate):
 
 
 def _sds(shape, dtype, like):
-    """ShapeDtypeStruct for a pallas_call output.
+    """vma-aware pallas output ShapeDtypeStruct (see
+    :func:`apex_tpu.utils.collectives.sds_like`)."""
+    from apex_tpu.utils.collectives import sds_like
 
-    Inside ``shard_map`` (manual mesh axes) JAX 0.9 requires the output's
-    varying-axes set to be declared explicitly; inherit it from a
-    representative input so the kernel works both standalone and under
-    an explicit-collective region (e.g. ring attention's n=1 path)."""
-    from apex_tpu.utils.collectives import manual_axes
-
-    if not manual_axes():
-        return jax.ShapeDtypeStruct(shape, dtype)
-    return jax.ShapeDtypeStruct(shape, dtype, vma=jax.typeof(like).vma)
+    return sds_like(shape, dtype, like)
 
 
 # ---------------------------------------------------------------------------
@@ -153,8 +147,11 @@ def _fwd_kernel(causal, scale, rate, sq, block_q, block_k,
         acc_scr[:] = jnp.zeros_like(acc_scr[:])
 
     def compute():
-        q = q_ref[0].astype(_f32)
-        k = k_ref[0].astype(_f32)
+        # operands stay in their native dtype (bf16 rides the MXU at
+        # full rate; upcasting first would run the dot at f32 rate,
+        # ~1/8 on v5e) — accumulation is f32 via preferred_element_type
+        q = q_ref[0]
+        k = k_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=_f32) * scale
         q_pos = qi * block_q + jax.lax.broadcasted_iota(
@@ -206,7 +203,8 @@ def _fwd_kernel(causal, scale, rate, sq, block_q, block_k,
 
 def _recompute_p(causal, scale, qi, ki, block_q, block_k, kv_len,
                  q, k, lse):
-    """p = exp(q k^T * scale - lse) with the forward's mask re-applied."""
+    """p = exp(q k^T * scale - lse) with the forward's mask re-applied.
+    ``q``/``k`` native dtype; accumulation f32 (MXU-rate dots)."""
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=_f32) * scale
     q_pos = qi * block_q + jax.lax.broadcasted_iota(
@@ -233,21 +231,23 @@ def _dq_kernel(causal, scale, rate, sq, block_q, block_k,
         dq_scr[:] = jnp.zeros_like(dq_scr[:])
 
     def compute():
-        q = q_ref[0].astype(_f32)
-        k = k_ref[0].astype(_f32)
-        do = do_ref[0].astype(_f32)
+        q = q_ref[0]
+        k = k_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0]                      # (block_q, 1)
         p, _ = _recompute_p(causal, scale, qi, ki, block_q, block_k,
                             len_ref[b], q, k, lse)
-        dp = jax.lax.dot_general(do, v_ref[0].astype(_f32),
-                                 (((1,), (1,)), ((), ())),
+        dp = jax.lax.dot_general(do, v_ref[0], (((1,), (1,)), ((), ())),
                                  preferred_element_type=_f32)
         if rate > 0.0:
             # dP = D∘(dO V^T): regenerate the forward's mask for this tile
             dp = dp * _keep_scale_tile(seed_ref[0], b, qi, ki, block_q,
                                        block_k, rate)
         ds = p * (dp - delta_ref[0]) * scale
-        dq_scr[:] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+        # ds cast to the operand dtype for the MXU-rate dot (the flash
+        # CUDA kernels do the same: dS is written back at input precision)
+        dq_scr[:] += jax.lax.dot_general(ds.astype(k.dtype), k,
+                                         (((1,), (0,)), ((), ())),
                                          preferred_element_type=_f32)
 
     if causal:
@@ -276,9 +276,9 @@ def _dkv_kernel(causal, scale, rate, sq, block_q, block_k,
         dv_scr[:] = jnp.zeros_like(dv_scr[:])
 
     def compute():
-        q = q_ref[0].astype(_f32)
-        k = k_ref[0].astype(_f32)
-        do = do_ref[0].astype(_f32)
+        q = q_ref[0]
+        k = k_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0]                      # (block_q, 1)
         p, valid = _recompute_p(causal, scale, qi, ki, block_q, block_k,
                                 len_ref[b], q, k, lse)
@@ -297,15 +297,16 @@ def _dkv_kernel(causal, scale, rate, sq, block_q, block_k,
             pd = p * dmask
         else:
             pd = p
-        dv_scr[:] += jax.lax.dot_general(pd, do, (((0,), (0,)), ((), ())),
+        dv_scr[:] += jax.lax.dot_general(pd.astype(do.dtype), do,
+                                         (((0,), (0,)), ((), ())),
                                          preferred_element_type=_f32)
-        dp = jax.lax.dot_general(do, v_ref[0].astype(_f32),
-                                 (((1,), (1,)), ((), ())),
+        dp = jax.lax.dot_general(do, v_ref[0], (((1,), (1,)), ((), ())),
                                  preferred_element_type=_f32)
         if rate > 0.0:
             dp = dp * dmask
         ds = p * (dp - delta_ref[0]) * scale
-        dk_scr[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+        dk_scr[:] += jax.lax.dot_general(ds.astype(q.dtype), q,
+                                         (((0,), (0,)), ((), ())),
                                          preferred_element_type=_f32)
 
     if causal:
@@ -546,7 +547,7 @@ def flash_attention_reference(q, k, v, causal=False, softmax_scale=None,
 
 
 def flash_attention(q, k, v, causal=False, softmax_scale=None,
-                    kv_seqlens=None, block_q=128, block_k=128,
+                    kv_seqlens=None, block_q=512, block_k=512,
                     dropout=0.0, dropout_seed=None):
     """Fused attention over ``(batch, heads, seq, head_dim)`` operands.
 
@@ -586,5 +587,17 @@ def flash_attention(q, k, v, causal=False, softmax_scale=None,
         kv_seqlens = jnp.full((b,), sk, jnp.int32)
     seed = jnp.reshape(jnp.asarray(
         0 if dropout_seed is None else dropout_seed, jnp.int32), (1,))
+    # big default blocks amortize Mosaic grid-step overhead (the
+    # (128,128) default cost ~2x wall-clock at seq 1024 on v5e); pick
+    # the largest candidate that doesn't inflate sequence padding, so
+    # arbitrary lengths (e.g. 640) don't round up to a whole 512 block
+    def _fit(requested, s):
+        s_pad = _round_up(s, 128)
+        for cand in (requested, 384, 256, 128):
+            if cand <= requested and s_pad % cand == 0:
+                return cand
+        return min(requested, s_pad)
+    block_q = _fit(int(block_q), sq)
+    block_k = _fit(int(block_k), sk)
     return _flash(q, k, v, kv_seqlens, seed, bool(causal), scale,
-                  int(block_q), int(block_k), rate)
+                  block_q, block_k, rate)

@@ -29,6 +29,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from apex_tpu.multi_tensor_apply.bucketing import LANE, _round_up
+from apex_tpu.utils.collectives import sds_like
 from apex_tpu.utils.platform import interpret_mode, use_pallas
 
 _f32 = jnp.float32
@@ -149,9 +150,9 @@ def _pallas_fwd(x2, w, b, eps, hidden, rms):
         grid=(rows_p // br,),
         in_specs=[row_spec, wb_spec] + ([wb_spec] if has_bias else []),
         out_specs=[row_spec, stat_spec, stat_spec],
-        out_shape=[jax.ShapeDtypeStruct((rows_p, hidden_p), x2.dtype),
-                   jax.ShapeDtypeStruct((rows_p, 1), _f32),
-                   jax.ShapeDtypeStruct((rows_p, 1), _f32)],
+        out_shape=[sds_like((rows_p, hidden_p), x2.dtype, x2),
+                   sds_like((rows_p, 1), _f32, x2),
+                   sds_like((rows_p, 1), _f32, x2)],
         interpret=interpret_mode(),
     )(*args)
     return y[:rows], mean[:rows], rstd[:rows]
@@ -191,9 +192,9 @@ def _pallas_bwd(dy2, res2, w, b, mean, rstd, hidden, rms, from_y):
         in_specs=[row_spec, row_spec, wb_spec, wb_spec, stat_spec,
                   stat_spec],
         out_specs=[row_spec, part_spec, part_spec],
-        out_shape=[jax.ShapeDtypeStruct((rows_p, hidden_p), dy2.dtype),
-                   jax.ShapeDtypeStruct((nblocks * 8, hidden_p), _f32),
-                   jax.ShapeDtypeStruct((nblocks * 8, hidden_p), _f32)],
+        out_shape=[sds_like((rows_p, hidden_p), dy2.dtype, dy2),
+                   sds_like((nblocks * 8, hidden_p), _f32, dy2),
+                   sds_like((nblocks * 8, hidden_p), _f32, dy2)],
         interpret=interpret_mode(),
     )(dy2, res2, w.reshape(1, -1), b_arr, mean, rstd)
     return dx[:rows], jnp.sum(dwp, axis=0), jnp.sum(dbp, axis=0)

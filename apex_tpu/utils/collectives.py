@@ -65,3 +65,22 @@ def psum_if_varying(tree, axis_name, strict: bool = False):
                 "is not a gradient, do not route it through this helper.")
         return v
     return jax.tree_util.tree_map_with_path(one, tree)
+
+
+def sds_like(shape, dtype, like):
+    """ShapeDtypeStruct for a ``pallas_call`` output, vma-aware.
+
+    Inside ``shard_map`` (manual mesh axes) JAX 0.9 requires the output's
+    varying-axes set; inherit it from a representative input so kernels
+    work standalone AND inside explicit-collective regions.  Under a
+    ``vmap``/``scan`` trace inside the region the batched aval can lose
+    its vma — fall back to "varying over every manual axis", the only
+    sound upper bound there.
+    """
+    ma = manual_axes()
+    if not ma:
+        return jax.ShapeDtypeStruct(shape, dtype)
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma is None:
+        vma = frozenset(ma)
+    return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
