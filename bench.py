@@ -328,6 +328,15 @@ def bench_fused_adam_vs_optax():
     # 1.6x..3x swings for this leg across rounds), so — like the MFU
     # leg — each pass times both sides back-to-back in one window and
     # the headline is the median per-pass ratio, with the spread shipped.
+    #
+    # Caveat on the ratio's meaning: this microbenchmark hands the step
+    # PRE-MATERIALIZED grads, so the bucket packing is a pure extra HBM
+    # round trip here; inside a real jitted train step XLA fuses the
+    # packing into the gradient producers. The standalone ratio is the
+    # WORST case for the packed engine (honest round-4 value ~0.4x, i.e.
+    # slower than per-leaf optax — the apex launch-overhead rationale
+    # does not exist on TPU; the packed layout's remaining wins are the
+    # ZeRO collectives and state layout).
     passes = []
     for _ in range(5):
         t_fused = _time_steps(fused_step, (grads, params, fstate),
