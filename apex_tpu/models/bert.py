@@ -39,6 +39,7 @@ class BertConfig:
     num_attention_heads: int = 16
     max_seq_len: int = 512
     type_vocab_size: int = 2
+    fused_lm_head: bool = True                 # logit-free blockwise CE
     ffn_hidden_size: Optional[int] = None      # default 4*hidden
     tensor_parallel_size: int = 1
     axis_name: Optional[str] = None
@@ -206,13 +207,17 @@ class BertModel:
 
     __call__ = apply
 
-    def mlm_logits(self, params, hidden):
-        """Tied-decoder vocab(-parallel) logits ``(b, s, vocab/t)``."""
+    def _mlm_transform(self, params, hidden):
+        """Transform + GELU + LN before the tied decoder."""
         h = (hidden.astype(_f32)
              @ params["mlm_transform"]["weight"].astype(_f32)
              + params["mlm_transform"]["bias"].astype(_f32))
         h = jax.nn.gelu(h, approximate=True)
-        h = self.mlm_layernorm(params["mlm_layernorm"], h)
+        return self.mlm_layernorm(params["mlm_layernorm"], h)
+
+    def mlm_logits(self, params, hidden):
+        """Tied-decoder vocab(-parallel) logits ``(b, s, vocab/t)``."""
+        h = self._mlm_transform(params, hidden)
         w = params["embedding"]["weight"]
         return jnp.einsum("bsh,vh->bsv", h.astype(_f32), w.astype(_f32))
 
@@ -223,13 +228,25 @@ class BertModel:
         ``mlm_labels``: original ids at masked positions, -1 elsewhere.
         """
         hidden = self.apply(params, tokens, token_type_ids, seqlens)
-        logits = self.mlm_logits(params, hidden)
-        b, s, vl = logits.shape
+        b, s = mlm_labels.shape
         mask = (mlm_labels >= 0)
         safe = jnp.where(mask, mlm_labels, 0)
-        per = tp.vocab_parallel_cross_entropy(
-            logits.reshape(b * s, vl), safe.reshape(b * s),
-            axis_name=self.cfg.axis_name).reshape(b, s)
+        if self.cfg.axis_name is None and self.cfg.fused_lm_head:
+            # logit-free tied decoder: the (b*s, vocab) logits never
+            # materialize (see ops/lm_head.py; the masked positions'
+            # losses are computed on target 0 and masked out below)
+            from apex_tpu.ops.lm_head import fused_linear_cross_entropy
+            h = self._mlm_transform(params, hidden)
+            per = fused_linear_cross_entropy(
+                h.reshape(b * s, h.shape[-1]),
+                params["embedding"]["weight"],
+                safe.reshape(b * s)).reshape(b, s)
+        else:
+            logits = self.mlm_logits(params, hidden)
+            vl = logits.shape[-1]
+            per = tp.vocab_parallel_cross_entropy(
+                logits.reshape(b * s, vl), safe.reshape(b * s),
+                axis_name=self.cfg.axis_name).reshape(b, s)
         denom = jnp.maximum(jnp.sum(mask), 1)
         loss = jnp.sum(jnp.where(mask, per, 0.0)) / denom
         if nsp_labels is not None:

@@ -150,3 +150,26 @@ class TestGPTFusedHead:
             mesh=mesh, in_specs=(in_specs, P(), P()),
             out_specs=P()))(packed, tokens, tokens))
         np.testing.assert_allclose(loss, ref, rtol=1e-5)
+
+
+class TestBertFusedHead:
+    def test_mlm_loss_fused_matches_materialized(self, rng):
+        from apex_tpu.models.bert import BertConfig, BertModel
+
+        kw = dict(vocab_size=128, hidden_size=32, num_layers=2,
+                  num_attention_heads=2, max_seq_len=16)
+        tokens = jnp.asarray(rng.randint(0, 128, (2, 16)))
+        labels = np.where(rng.rand(2, 16) < 0.3,
+                          rng.randint(0, 128, (2, 16)), -1)
+        labels = jnp.asarray(labels)
+        out = {}
+        for fused in (True, False):
+            m = BertModel(BertConfig(fused_lm_head=fused, **kw))
+            p = m.init_params(jax.random.PRNGKey(0))
+            loss, g = jax.value_and_grad(m.loss)(p, tokens, labels)
+            out[fused] = (float(loss), g)
+        np.testing.assert_allclose(out[True][0], out[False][0], rtol=1e-5)
+        for a, b in zip(jax.tree_util.tree_leaves(out[True][1]),
+                        jax.tree_util.tree_leaves(out[False][1])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-6)
