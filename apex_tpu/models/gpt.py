@@ -39,6 +39,7 @@ _f32 = jnp.float32
 # layer i+1's mask exactly.
 _SEED_LAYER_STRIDE = 0x3C6EF35F
 _SEED_MB_STRIDE = 0x5BD1E995
+_SEED_TP_RANK_STRIDE = 0x7F4A7C15  # per-TP-rank dropout stream offset
 
 
 def _remat_policy(name: str):
@@ -179,8 +180,16 @@ class ParallelAttention:
             # (eval) means no dropout
             rate = cfg.attention_dropout if dropout_seed is not None \
                 else 0.0
+            seed = dropout_seed
+            if seed is not None and cfg.axis_name is not None:
+                # the counter hash keys on the LOCAL (batch, head) index,
+                # so without an offset head j on every TP rank (different
+                # global heads) would draw bit-identical masks; stride the
+                # seed by rank like Megatron's per-TP-rank dropout RNG
+                seed = seed + (jax.lax.axis_index(cfg.axis_name)
+                               * _SEED_TP_RANK_STRIDE)
             ctx = flash_attention(q, k, v, causal=True, dropout=rate,
-                                  dropout_seed=dropout_seed)
+                                  dropout_seed=seed)
         ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, nh * cfg.head_dim)
         out, _ = self.proj(params["proj"], ctx)
         return out
@@ -672,8 +681,8 @@ def make_stage_fn(model: GPTModel, with_dropout_seed: bool = False):
     * MoE models: ``aux`` — each stage adds its local layers' Switch aux
       contributions, so the last stage holds the per-microbatch total.
     * ``with_dropout_seed``: ``seed`` — the attention-dropout stream,
-      incremented once per layer, so layer ``i`` of the pipeline uses
-      ``base_seed + i`` exactly like the serial backbone, with no
+      advanced by ``_SEED_LAYER_STRIDE`` per layer as it rides the carry,
+      matching the serial backbone's ``base + i * stride`` walk with no
       stage/virtual-chunk index arithmetic.
 
     Tuple order: ``(x[, aux][, seed])``.

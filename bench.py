@@ -71,7 +71,10 @@ def _sync(x):
     "complete" in under a millisecond), which silently voids every
     timing built on it; a host readback cannot lie."""
     leaf = jax.tree_util.tree_leaves(x)[0]
-    np.asarray(jax.device_get(jnp.ravel(leaf)[:1]))
+    # single-element index, not ravel: outside jit a ravel dispatches a
+    # full-size reshape program with a fresh output buffer, transiently
+    # doubling the leaf's HBM footprint
+    np.asarray(jax.device_get(leaf[(0,) * leaf.ndim]))
     return x
 
 

@@ -132,6 +132,16 @@ def main():
     print(f"gpt7b: params={n_params / 1e9:.2f}B mesh=(dp={dp}, pp={pp}, "
           f"tp={tp}) devices={n} tokens/step={tokens_per_step}")
 
+    def hard_sync(tree):
+        # bench.py::_sync pattern — a 1-element device->host readback.
+        # jax.block_until_ready can return before device work retires in
+        # some remote-device environments (see BASELINE.md round-4
+        # correction), which silently voids the timing below.
+        leaf = jax.tree_util.tree_leaves(tree)[0]
+        # index a single element (not ravel: that dispatches a full-size
+        # reshape outside jit, transiently doubling the leaf's HBM)
+        np.asarray(jax.device_get(leaf[(0,) * leaf.ndim]))
+
     losses, t0 = [], None
     for step in range(args.steps):
         tokens = jnp.asarray(
@@ -142,10 +152,10 @@ def main():
                                              targets)
         losses.append(float(loss))
         if step == 0:
-            jax.block_until_ready(packed)
+            hard_sync(packed)
             t0 = time.perf_counter()          # exclude compile
         print(f"step {step}: loss={losses[-1]:.4f}")
-    jax.block_until_ready(packed)
+    hard_sync(packed)
     if args.steps > 1 and t0 is not None:
         dt = (time.perf_counter() - t0) / (args.steps - 1)
         per_chip = tokens_per_step / dt / n

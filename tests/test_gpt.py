@@ -369,6 +369,49 @@ class TestAttentionDropout:
         assert a != none
         assert np.isfinite([a, c, none]).all()
 
+    def test_tp_ranks_draw_independent_masks(self, rng):
+        """Under tensor parallelism each rank holds DIFFERENT global
+        heads, so the attention-dropout streams must differ per rank
+        (ADVICE r4: the counter hash keys on the LOCAL head index; the
+        model folds a per-rank stride into the seed, like Megatron's
+        per-TP-rank dropout RNG offset)."""
+        cfg = tiny_cfg(attention_dropout=0.5, hidden_size=32,
+                       num_attention_heads=4, max_seq_len=16,
+                       tensor_parallel_size=2, axis_name="model")
+        model = GPTModel(cfg)
+        layer_attn = model.layers[0].attention
+        serial = GPTModel(tiny_cfg(hidden_size=32, num_attention_heads=4,
+                                   max_seq_len=16))
+        params = serial.layers[0].attention.init_params(
+            jax.random.PRNGKey(3))
+        mesh = jax.make_mesh((2,), ("model",))
+        x = jnp.asarray(rng.randn(2, 16, 32).astype(np.float32))
+
+        # give BOTH ranks the same local qkv/proj shard: any output
+        # difference between ranks can then only come from the dropout
+        # mask stream
+        half = {"qkv": {"weight": params["qkv"]["weight"][:48],
+                        "bias": params["qkv"]["bias"][:48]},
+                "proj": {"weight": params["proj"]["weight"][:, :16],
+                         "bias": params["proj"]["bias"]}}
+
+        def fn(p, x):
+            return layer_attn(p, x, None, None, dropout_seed=jnp.int32(9))
+
+        out = jax.jit(shard_map(
+            fn, mesh=mesh, in_specs=(P(), P()), out_specs=P()))(half, x)
+
+        # serial twin on the same half shard draws rank-0's stream
+        # (offset 0, seed 9); with IDENTICAL masks across ranks the
+        # RowParallel psum would make the TP output exactly 2x the
+        # serial partial (bias is zero) — independence breaks that
+        scfg = tiny_cfg(attention_dropout=0.5, hidden_size=32,
+                        num_attention_heads=4, max_seq_len=16)
+        twin = GPTModel(scfg).layers[0].attention
+        ref = twin(half, x, None, None, dropout_seed=jnp.int32(9))
+        assert not np.allclose(np.asarray(out), 2 * np.asarray(ref)), (
+            "identical dropout masks across TP ranks")
+
 
 class TestSelectiveRemat:
     """Megatron 'selective activation recompute' parity: remat_policy=
