@@ -237,9 +237,12 @@ class BertModel:
             # losses are computed on target 0 and masked out below)
             from apex_tpu.ops.lm_head import fused_linear_cross_entropy
             h = self._mlm_transform(params, hidden)
+            # compute-dtype operands: the kernel dots at operand
+            # precision (see GPTModel.head_loss) — under O2 the tied
+            # embedding is bf16 already and h comes out of the f32 LN
             per = fused_linear_cross_entropy(
-                h.reshape(b * s, h.shape[-1]),
-                params["embedding"]["weight"],
+                h.reshape(b * s, h.shape[-1]).astype(self.cfg.dtype),
+                params["embedding"]["weight"].astype(self.cfg.dtype),
                 safe.reshape(b * s)).reshape(b, s)
         else:
             logits = self.mlm_logits(params, hidden)

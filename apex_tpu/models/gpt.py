@@ -407,9 +407,13 @@ class GPTModel:
         if self.cfg.axis_name is None and self.cfg.fused_lm_head:
             from apex_tpu.ops.lm_head import fused_linear_cross_entropy
             h = self.final_layernorm(params["final_layernorm"], x)
+            # head operands at the COMPUTE dtype: the kernel dots at the
+            # operand precision (f32 dots run ~1/8 the bf16 MXU rate),
+            # and the head GEMMs are the largest single matmuls in the
+            # step; accumulation/logsumexp stay f32 inside the kernel
             return fused_linear_cross_entropy(
-                h.reshape(b * s, h.shape[-1]),
-                params["embedding"]["weight"],
+                h.reshape(b * s, h.shape[-1]).astype(self.cfg.dtype),
+                params["embedding"]["weight"].astype(self.cfg.dtype),
                 targets.reshape(b * s)).reshape(b, s)
         logits = self.logits(params, x)
         vl = logits.shape[-1]

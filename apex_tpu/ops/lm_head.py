@@ -49,6 +49,20 @@ __all__ = ["fused_linear_cross_entropy",
 # forward kernel
 # ---------------------------------------------------------------------------
 
+def _dot_dtype(x_dtype, w_dtype):
+    """Operand dtype for the logit dots: if either side is bf16 the
+    GEMM runs at bf16 (accumulation stays f32 via
+    ``preferred_element_type``) — under O2 the tied embedding IS bf16,
+    and upcasting operands to f32 costs MXU rate for accumulation
+    precision the f32 path already provides.  (Only bf16 is special:
+    Mosaic has no f16 vector type, so f16 operands never reach these
+    kernels.)"""
+    for dt in (x_dtype, w_dtype):
+        if jnp.dtype(dt) == jnp.bfloat16:
+            return jnp.bfloat16
+    return _f32
+
+
 def _fwd_kernel(n_valid, v_valid, block_t, block_v,
                 tgt_ref, x_ref, w_ref, loss_ref, lse_ref,
                 m_scr, l_scr, t_scr):
@@ -61,8 +75,9 @@ def _fwd_kernel(n_valid, v_valid, block_t, block_v,
         l_scr[:] = jnp.zeros_like(l_scr[:])
         t_scr[:] = jnp.zeros_like(t_scr[:])
 
-    x = x_ref[:].astype(_f32)
-    w = w_ref[:].astype(_f32)
+    dt = _dot_dtype(x_ref.dtype, w_ref.dtype)
+    x = x_ref[:].astype(dt)
+    w = w_ref[:].astype(dt)
     s = jax.lax.dot_general(x, w, (((1,), (1,)), ((), ())),
                             preferred_element_type=_f32)
     v_pos = vi * block_v + jax.lax.broadcasted_iota(
@@ -116,14 +131,18 @@ def _dx_kernel(v_valid, block_t, block_v,
     def _init():
         dx_scr[:] = jnp.zeros_like(dx_scr[:])
 
-    x = x_ref[:].astype(_f32)
-    w = w_ref[:].astype(_f32)
+    dt = _dot_dtype(x_ref.dtype, w_ref.dtype)
+    x = x_ref[:].astype(dt)
+    w = w_ref[:].astype(dt)
     s = jax.lax.dot_general(x, w, (((1,), (1,)), ((), ())),
                             preferred_element_type=_f32)
     ds = _p_minus_onehot(None, vi, x.shape[0], block_v, v_valid,
                          tgt_ref[:], lse_ref[:], s)
     ds = ds * g_ref[:]                       # per-token upstream cotangent
-    dx_scr[:] += jax.lax.dot_general(ds, w, (((1,), (0,)), ((), ())),
+    # dS cast to the operand dtype for the MXU-rate dot (same trade as
+    # the flash backward: dS is written back at input precision)
+    dx_scr[:] += jax.lax.dot_general(ds.astype(dt), w,
+                                     (((1,), (0,)), ((), ())),
                                      preferred_element_type=_f32)
 
     @pl.when(vi == nv - 1)
@@ -141,8 +160,9 @@ def _dw_kernel(n_valid, v_valid, block_t, block_v,
     def _init():
         dw_scr[:] = jnp.zeros_like(dw_scr[:])
 
-    x = x_ref[:].astype(_f32)
-    w = w_ref[:].astype(_f32)
+    dt = _dot_dtype(x_ref.dtype, w_ref.dtype)
+    x = x_ref[:].astype(dt)
+    w = w_ref[:].astype(dt)
     s = jax.lax.dot_general(x, w, (((1,), (1,)), ((), ())),
                             preferred_element_type=_f32)
     block_t_ = x.shape[0]
@@ -153,7 +173,8 @@ def _dw_kernel(n_valid, v_valid, block_t, block_v,
     t_pos = ti * block_t + jax.lax.broadcasted_iota(
         jnp.int32, (block_t_, block_v), 0)
     ds = jnp.where(t_pos < n_valid, ds, 0.0)
-    dw_scr[:] += jax.lax.dot_general(ds, x, (((0,), (0,)), ((), ())),
+    dw_scr[:] += jax.lax.dot_general(ds.astype(dt), x,
+                                     (((0,), (0,)), ((), ())),
                                      preferred_element_type=_f32)
 
     @pl.when(ti == nt - 1)
@@ -320,6 +341,8 @@ def fused_linear_cross_entropy(x, w, targets, *, block_t=256,
     """
     N, H = x.shape
     V = w.shape[0]
-    if not use_pallas():
+    if not use_pallas() or jnp.float16 in (x.dtype, w.dtype):
+        # f16: Mosaic has no f16 vector type (same gate as
+        # ops/multi_tensor.py::_use_kernel)
         return fused_linear_cross_entropy_reference(x, w, targets)
     return _fused(x, w, targets, int(block_t), int(block_v))

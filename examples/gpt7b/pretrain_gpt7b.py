@@ -138,9 +138,17 @@ def main():
         # some remote-device environments (see BASELINE.md round-4
         # correction), which silently voids the timing below.
         leaf = jax.tree_util.tree_leaves(tree)[0]
-        # index a single element (not ravel: that dispatches a full-size
-        # reshape outside jit, transiently doubling the leaf's HBM)
-        np.asarray(jax.device_get(leaf[(0,) * leaf.ndim]))
+        if leaf.is_fully_addressable:
+            # index a single element (not ravel: that dispatches a
+            # full-size reshape outside jit, transiently doubling the
+            # leaf's HBM footprint)
+            np.asarray(jax.device_get(leaf[(0,) * leaf.ndim]))
+        else:
+            # multi-host pod: shards on other hosts are not addressable
+            # here — readback would raise; block_until_ready is the only
+            # portable sync (its known weakness is a single-process
+            # remote-device tunnel, which is never the pod case)
+            jax.block_until_ready(tree)
 
     losses, t0 = [], None
     for step in range(args.steps):
