@@ -72,6 +72,14 @@ class _DistributedMixin:
         self.world_size = int(world_size)
         self.axis_name = axis_name
         self.average_grads = bool(average_grads)
+        # ZeRO sharding IS the packed layout: the reduce-scatter /
+        # all-gather shard whole (rows, 128) blocks.  The per-leaf
+        # layout has nothing to shard evenly — force bucketed.
+        if not self.bucketed:
+            raise ValueError(
+                "distributed (ZeRO) optimizers require bucketed=True — "
+                "the packed (rows, 128) buckets are what reduce-scatter/"
+                "all-gather shard")
 
     def _meta_block_rows(self):
         return self.block_rows * self.world_size

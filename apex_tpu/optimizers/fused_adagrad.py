@@ -42,3 +42,30 @@ class FusedAdagrad(FusedOptimizer):
                 weight_decay=hyper["weight_decay"], grad_scale=grad_scale,
                 noop_flag=noop, block_rows=self.block_rows)
         return p_new, {"sum": h_new}
+
+    # -- per-leaf (bucketed=False) layout -----------------------------------
+
+    def _init_leaves(self, info, ps):
+        return {"sum": [jnp.zeros(p.shape, jnp.float32) for p in ps]}
+
+    def _update_leaves(self, info, gs, ps, st, hyper, step_count, grad_scale,
+                       noop, extras):
+        from apex_tpu.ops.multi_tensor import _adagrad_math
+        w_mode = hyper["adagrad_w_mode"]
+        scal = jnp.stack([jnp.asarray(s, jnp.float32) for s in
+                          (hyper["lr"], hyper["eps"],
+                           0.0 if w_mode else hyper["weight_decay"],
+                           grad_scale)])
+        skip = False if noop is None else (noop != 0)
+        decay = hyper["lr"] * hyper["weight_decay"]
+        new_ps, hs = [], []
+        for g, p, h in zip(gs, ps, st["sum"]):
+            pf = p.astype(jnp.float32)
+            p2, h2 = _adagrad_math(scal, skip, g.astype(jnp.float32), pf, h)
+            if w_mode:
+                # decoupled decay outside the accumulator (same as the
+                # bucketed branch)
+                p2 = jnp.where(skip, pf, p2 - decay * pf)
+            new_ps.append(p2)
+            hs.append(h2)
+        return new_ps, {"sum": hs}

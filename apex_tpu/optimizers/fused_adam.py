@@ -42,12 +42,7 @@ class FusedAdam(FusedOptimizer):
     def _update_bucket(self, info, g, p, st, hyper, step_count, grad_scale,
                        noop, extras):
         beta1, beta2 = hyper["betas"]
-        if hyper["bias_correction"]:
-            t = step_count.astype(jnp.float32)
-            bc1 = 1.0 - beta1 ** t
-            bc2 = 1.0 - beta2 ** t
-        else:
-            bc1 = bc2 = 1.0
+        bc1, bc2 = self._bias_corrections(hyper, step_count)
         p_new, m_new, v_new = K.adam_packed(
             g, p, st["m"], st["v"], lr=hyper["lr"], beta1=beta1, beta2=beta2,
             eps=hyper["eps"], weight_decay=hyper["weight_decay"],
@@ -55,3 +50,35 @@ class FusedAdam(FusedOptimizer):
             grad_scale=grad_scale, adam_w_mode=hyper["adam_w_mode"],
             noop_flag=noop, block_rows=self.block_rows)
         return p_new, {"m": m_new, "v": v_new}
+
+    @staticmethod
+    def _bias_corrections(hyper, step_count):
+        beta1, beta2 = hyper["betas"]
+        if hyper["bias_correction"]:
+            t = step_count.astype(jnp.float32)
+            return 1.0 - beta1 ** t, 1.0 - beta2 ** t
+        return 1.0, 1.0
+
+    # -- per-leaf (bucketed=False) layout -----------------------------------
+
+    def _init_leaves(self, info, ps):
+        return {"m": [jnp.zeros(p.shape, jnp.float32) for p in ps],
+                "v": [jnp.zeros(p.shape, jnp.float32) for p in ps]}
+
+    def _update_leaves(self, info, gs, ps, st, hyper, step_count, grad_scale,
+                       noop, extras):
+        beta1, beta2 = hyper["betas"]
+        bc1, bc2 = self._bias_corrections(hyper, step_count)
+        scal = jnp.stack([jnp.asarray(s, jnp.float32) for s in
+                          (hyper["lr"], beta1, beta2, hyper["eps"],
+                           hyper["weight_decay"], bc1, bc2, grad_scale)])
+        skip = False if noop is None else (noop != 0)
+        new_ps, ms, vs = [], [], []
+        for g, p, m, v in zip(gs, ps, st["m"], st["v"]):
+            p2, m2, v2 = K._adam_math(
+                hyper["adam_w_mode"], scal, skip, g.astype(jnp.float32),
+                p.astype(jnp.float32), m, v)
+            new_ps.append(p2)
+            ms.append(m2)
+            vs.append(v2)
+        return new_ps, {"m": ms, "v": vs}

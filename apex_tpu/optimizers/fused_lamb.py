@@ -58,15 +58,18 @@ class FusedLAMB(FusedOptimizer):
         clip = jnp.where(gnorm > max_norm, max_norm / gnorm, 1.0)
         return {"global_grad_clip": clip}
 
-    def _update_bucket(self, info, g, p, st, hyper, step_count, grad_scale,
-                       noop, extras):
+    @staticmethod
+    def _bias_corrections(hyper, step_count):
         beta1, beta2 = hyper["betas"]
         if hyper["bias_correction"]:
             t = step_count.astype(_f32)
-            bc1 = 1.0 - beta1 ** t
-            bc2 = 1.0 - beta2 ** t
-        else:
-            bc1 = bc2 = 1.0
+            return 1.0 - beta1 ** t, 1.0 - beta2 ** t
+        return 1.0, 1.0
+
+    def _update_bucket(self, info, g, p, st, hyper, step_count, grad_scale,
+                       noop, extras):
+        beta1, beta2 = hyper["betas"]
+        bc1, bc2 = self._bias_corrections(hyper, step_count)
         u, m_new, v_new, usq, psq = K.lamb_stage1_packed(
             g, p, st["m"], st["v"], beta1=beta1, beta2=beta2,
             eps=hyper["eps"], weight_decay=hyper["weight_decay"],
@@ -88,6 +91,54 @@ class FusedLAMB(FusedOptimizer):
                                      noop_flag=noop,
                                      block_rows=self.block_rows)
         return p_new, {"m": m_new, "v": v_new}
+
+    # -- per-leaf (bucketed=False) layout -----------------------------------
+
+    def _init_leaves(self, info, ps):
+        return {"m": [jnp.zeros(p.shape, _f32) for p in ps],
+                "v": [jnp.zeros(p.shape, _f32) for p in ps]}
+
+    def _pre_step_leaves(self, layout, g_leaves, state, *, lr, grad_scale):
+        total_sq = sum(jnp.sum(jnp.square(g.astype(_f32)))
+                       for g in g_leaves)
+        gnorm = jnp.sqrt(total_sq) * jnp.asarray(grad_scale, _f32)
+        max_norm = jnp.asarray(self.defaults["max_grad_norm"], _f32)
+        clip = jnp.where(gnorm > max_norm, max_norm / gnorm, 1.0)
+        return {"global_grad_clip": clip}
+
+    def _update_leaves(self, info, gs, ps, st, hyper, step_count, grad_scale,
+                       noop, extras):
+        from apex_tpu.ops.multi_tensor import _lamb_stage1_math
+        beta1, beta2 = hyper["betas"]
+        bc1, bc2 = self._bias_corrections(hyper, step_count)
+        beta3 = 1.0 - beta1 if hyper["grad_averaging"] else 1.0
+        scal = jnp.stack([jnp.asarray(s, _f32) for s in
+                          (beta1, beta2, hyper["eps"],
+                           hyper["weight_decay"], bc1, bc2, grad_scale,
+                           extras["global_grad_clip"], beta3)])
+        skip = False if noop is None else (noop != 0)
+        lr_ = jnp.asarray(hyper["lr"], _f32)
+        new_ps, ms, vs = [], [], []
+        for g, p, m, v in zip(gs, ps, st["m"], st["v"]):
+            # the (1, n) view makes the stage-1 kernel math's axis-1 row
+            # sums the per-TENSOR sums — same single-source update
+            p1 = p.astype(_f32).reshape(1, -1)
+            u, m2, v2, usq, psq = _lamb_stage1_math(
+                hyper["adam_w_mode"], scal, skip,
+                g.astype(_f32).reshape(1, -1), p1,
+                m.reshape(1, -1), v.reshape(1, -1))
+            p_norm = jnp.sqrt(psq[0, 0])
+            u_norm = jnp.sqrt(usq[0, 0])
+            if hyper["use_nvlamb"]:
+                ratio = jnp.where(u_norm > 0, p_norm / u_norm, 1.0)
+            else:
+                ratio = jnp.where((p_norm > 0) & (u_norm > 0),
+                                  p_norm / u_norm, 1.0)
+            p2 = jnp.where(skip, p1, p1 - lr_ * ratio * u)
+            new_ps.append(p2.reshape(p.shape))
+            ms.append(m2.reshape(p.shape))
+            vs.append(v2.reshape(p.shape))
+        return new_ps, {"m": ms, "v": vs}
 
 
 class FusedMixedPrecisionLamb(FusedLAMB):

@@ -70,3 +70,43 @@ class FusedNovoGrad(FusedOptimizer):
             reg_inside_moment=hyper["reg_inside_moment"],
             noop_flag=noop, block_rows=self.block_rows)
         return p_new, {"m": m_new, "v": v_new}
+
+    # -- per-leaf (bucketed=False) layout -----------------------------------
+
+    def _init_leaves(self, info, ps):
+        return {"m": [jnp.zeros(p.shape, _f32) for p in ps],
+                "v": [jnp.zeros((), _f32) for _ in ps]}
+
+    def _update_leaves(self, info, gs, ps, st, hyper, step_count, grad_scale,
+                       noop, extras):
+        from apex_tpu.ops.multi_tensor import _novograd_math
+        beta1, beta2 = hyper["betas"]
+        if hyper["bias_correction"]:
+            t = step_count.astype(_f32)
+            lr_eff = hyper["lr"] * jnp.sqrt(1.0 - beta2 ** t) / \
+                (1.0 - beta1 ** t)
+        else:
+            lr_eff = hyper["lr"]
+        beta3 = 1.0 - beta1 if hyper["grad_averaging"] else 1.0
+        scal = jnp.stack([jnp.asarray(s, _f32) for s in
+                          (lr_eff, beta1, hyper["weight_decay"],
+                           hyper["eps"], grad_scale, beta3)])
+        skip = False if noop is None else (noop != 0)
+        new_ps, ms, vs = [], [], []
+        for g, p, m, v in zip(gs, ps, st["m"], st["v"]):
+            gf = g.astype(_f32)
+            gnorm_sq = jnp.sum(gf * gf) * jnp.asarray(grad_scale, _f32) ** 2
+            if hyper["init_zero"]:
+                v2 = beta2 * v + (1.0 - beta2) * gnorm_sq
+            else:
+                v2 = jnp.where(step_count == 1, gnorm_sq,
+                               beta2 * v + (1.0 - beta2) * gnorm_sq)
+            if noop is not None:
+                v2 = jnp.where(noop != 0, v, v2)
+            p2, m2 = _novograd_math(
+                bool(hyper["reg_inside_moment"]), scal, skip, gf,
+                p.astype(_f32), m, v2)
+            new_ps.append(p2)
+            ms.append(m2)
+            vs.append(v2)
+        return new_ps, {"m": ms, "v": vs}

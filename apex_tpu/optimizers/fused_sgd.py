@@ -54,3 +54,30 @@ class FusedSGD(FusedOptimizer):
             grad_scale=grad_scale, noop_flag=noop,
             block_rows=self.block_rows)
         return p_new, {"momentum_buffer": buf_new}
+
+    # -- per-leaf (bucketed=False) layout -----------------------------------
+
+    def _init_leaves(self, info, ps):
+        return {"momentum_buffer": [jnp.zeros(p.shape, jnp.float32)
+                                    for p in ps]}
+
+    def _update_leaves(self, info, gs, ps, st, hyper, step_count, grad_scale,
+                       noop, extras):
+        from apex_tpu.ops.multi_tensor import _sgd_math
+        damp = jnp.where(step_count == 1, 0.0,
+                         jnp.asarray(hyper["dampening"], jnp.float32))
+        scal = jnp.stack([jnp.asarray(s, jnp.float32) for s in
+                          (hyper["lr"], hyper["weight_decay"],
+                           hyper["momentum"], damp, grad_scale)])
+        momentum = hyper["momentum"]
+        momentum_zero = isinstance(momentum, (int, float)) and momentum == 0.0
+        flags = (bool(hyper["nesterov"]), False,
+                 bool(hyper["wd_after_momentum"]), momentum_zero)
+        skip = False if noop is None else (noop != 0)
+        new_ps, bufs = [], []
+        for g, p, buf in zip(gs, ps, st["momentum_buffer"]):
+            p2, b2 = _sgd_math(*flags, scal, skip, g.astype(jnp.float32),
+                               p.astype(jnp.float32), buf)
+            new_ps.append(p2)
+            bufs.append(b2)
+        return new_ps, {"momentum_buffer": bufs}

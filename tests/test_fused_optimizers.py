@@ -354,3 +354,81 @@ class TestMasterParams:
         for m, p in zip(jax.tree_util.tree_leaves(masters),
                         jax.tree_util.tree_leaves(params)):
             np.testing.assert_array_equal(np.asarray(m), np.asarray(p))
+
+
+class TestPerLeafLayout:
+    """bucketed=False: the per-leaf layout must walk the SAME trajectory
+    as the packed engine (identical _*_math single-source updates), for
+    every optimizer family, including masters, noop and param groups."""
+
+    OPTS = [
+        (FusedAdam, dict(lr=1e-2, weight_decay=0.05)),
+        (FusedAdam, dict(lr=1e-2, weight_decay=0.1, adam_w_mode=False,
+                         bias_correction=False)),
+        (FusedSGD, dict(lr=1e-2, momentum=0.9, weight_decay=0.01)),
+        (FusedLAMB, dict(lr=1e-2, weight_decay=0.01)),
+        (FusedLAMB, dict(lr=1e-2, use_nvlamb=True, grad_averaging=False)),
+        (FusedNovoGrad, dict(lr=1e-2, weight_decay=0.01)),
+        (FusedAdagrad, dict(lr=1e-2, weight_decay=0.01)),
+        (FusedAdagrad, dict(lr=1e-2, weight_decay=0.01,
+                            adagrad_w_mode=True)),
+    ]
+
+    @pytest.mark.parametrize("cls,kw", OPTS,
+                             ids=lambda o: getattr(o, "__name__", None))
+    def test_matches_packed_trajectory(self, rng, cls, kw):
+        params = make_params(rng)
+        packed = cls(**kw)
+        leaf = cls(bucketed=False, **kw)
+        ps, ss = params, packed.init(params)
+        pl_, sl = params, leaf.init(params)
+        pstep, lstep = jax.jit(packed.step), jax.jit(leaf.step)
+        for _ in range(4):
+            grads = make_grads(rng, params)
+            ps, ss = pstep(grads, ps, ss)
+            pl_, sl = lstep(grads, pl_, sl)
+            tree_allclose(ps, pl_, rtol=1e-6, atol=1e-7)
+        assert int(sl["step"]) == 4
+
+    def test_master_weights_and_noop(self, rng):
+        params32 = make_params(rng)
+        bf16 = jax.tree_util.tree_map(
+            lambda p: p.astype(jnp.bfloat16), params32)
+        packed = FusedLAMB(lr=1e-2, master_weights=True)
+        leaf = FusedLAMB(lr=1e-2, master_weights=True, bucketed=False)
+        ps, ss = bf16, packed.init(bf16)
+        pl_, sl = bf16, leaf.init(bf16)
+        for i in range(3):
+            grads = make_grads(rng, bf16)
+            noop = jnp.asarray(1 if i == 1 else 0)  # skip the middle step
+            ps, ss = packed.step(grads, ps, ss, noop_flag=noop)
+            pl_, sl = leaf.step(grads, pl_, sl, noop_flag=noop)
+            tree_allclose(ps, pl_, rtol=1e-6, atol=1e-7)
+        assert int(ss["step"]) == int(sl["step"]) == 2
+        # per-leaf masters are leaf-shaped fp32
+        from apex_tpu import amp
+        m = amp.master_params(leaf, pl_, sl)
+        for lm, lp in zip(jax.tree_util.tree_leaves(m),
+                          jax.tree_util.tree_leaves(pl_)):
+            assert lm.dtype == jnp.float32 and lm.shape == lp.shape
+
+    def test_param_groups(self, rng):
+        params = make_params(rng)
+        group_fn = lambda path: ("no_decay" if "bias" in path or "scale"
+                                 in path else "default")
+        kw = dict(lr=1e-2, weight_decay=0.1, param_group_fn=group_fn,
+                  param_groups={"no_decay": {"weight_decay": 0.0}})
+        packed, leaf = FusedAdam(**kw), FusedAdam(bucketed=False, **kw)
+        ps, ss = params, packed.init(params)
+        pl_, sl = params, leaf.init(params)
+        for _ in range(3):
+            grads = make_grads(rng, params)
+            ps, ss = packed.step(grads, ps, ss)
+            pl_, sl = leaf.step(grads, pl_, sl)
+        tree_allclose(ps, pl_, rtol=1e-6, atol=1e-7)
+
+    def test_zero_requires_bucketed(self):
+        from apex_tpu.contrib.optimizers import DistributedFusedAdam
+        with pytest.raises(ValueError, match="bucketed"):
+            DistributedFusedAdam(lr=1e-3, world_size=2, axis_name="data",
+                                 bucketed=False)

@@ -1,0 +1,47 @@
+"""Shared hard-sync timing protocol for the on-chip tools.
+
+Single home for the tools' copy of bench.py's measurement discipline:
+``jax.block_until_ready`` can return before device work retires through
+the axon remote-device tunnel (see BASELINE.md round-4 correction), so
+every timing hard-synchronizes with a 1-element device->host readback.
+bench.py keeps its own copy by contract — the driver runs it as a
+standalone single-file benchmark — so a change to the protocol must be
+mirrored there (and vice versa; bench.py::_sync points back here).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+# make `import apex_tpu` work regardless of the caller's CWD
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax                                                   # noqa: E402
+import numpy as np                                           # noqa: E402
+
+
+def sync(x):
+    """Hard synchronization: 1-element device->host read of a leaf
+    (single-element index, not ravel — an out-of-jit ravel dispatches a
+    full-size reshape that transiently doubles the leaf's HBM)."""
+    leaf = jax.tree_util.tree_leaves(x)[0]
+    np.asarray(jax.device_get(leaf[(0,) * leaf.ndim]))
+    return x
+
+
+def time_steps(fn, args, warmup=2, iters=8, rounds=3):
+    """Median seconds per call over ``rounds`` hard-synced windows."""
+    for _ in range(warmup):
+        out = fn(*args)
+    sync(out)
+    times = []
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        sync(out)
+        times.append((time.perf_counter() - t0) / iters)
+    times.sort()
+    return times[len(times) // 2]

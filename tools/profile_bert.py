@@ -15,37 +15,15 @@ from __future__ import annotations
 
 import functools
 import sys
-import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-sys.path.insert(0, ".")
+from _timing import sync as _sync, time_steps as _time  # noqa: E402 (sets sys.path)
 
 
-def _sync(x):
-    leaf = jax.tree_util.tree_leaves(x)[0]
-    np.asarray(jax.device_get(leaf[(0,) * leaf.ndim]))
-    return x
-
-
-def _time(fn, args, warmup=2, iters=8, rounds=3):
-    for _ in range(warmup):
-        out = fn(*args)
-    _sync(out)
-    times = []
-    for _ in range(rounds):
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            out = fn(*args)
-        _sync(out)
-        times.append((time.perf_counter() - t0) / iters)
-    times.sort()
-    return times[len(times) // 2]
-
-
-def make_step(batch, remat, policy, accum=1):
+def make_step(batch, remat, policy, accum=1, leaf=False):
     from apex_tpu import amp
     from apex_tpu.models.bert import BertConfig, BertModel
     from apex_tpu.optimizers import FusedLAMB
@@ -56,7 +34,7 @@ def make_step(batch, remat, policy, accum=1):
                      dtype=jnp.bfloat16)
     seq = 512
     model = BertModel(cfg)
-    lamb = FusedLAMB(lr=1e-3)
+    lamb = FusedLAMB(lr=1e-3, bucketed=not leaf)
     state = amp.initialize(model.loss, lamb, opt_level="O2")
     params = state.cast_params(model.init_params(jax.random.PRNGKey(0)))
     opt_state = lamb.init(params)
@@ -110,6 +88,20 @@ def sweep():
                             accum=2)),
         ("b8_none", dict(batch=8, remat=False, policy="full")),
         ("b16_none", dict(batch=16, remat=False, policy="full")),
+        ("b32_dots_leaf", dict(batch=32, remat=True, policy="dots",
+                               leaf=True)),
+        ("b16x2_dots_leaf", dict(batch=16, remat=True, policy="dots",
+                                 accum=2, leaf=True)),
+        ("b24_dots_leaf", dict(batch=24, remat=True, policy="dots",
+                               leaf=True)),
+        ("b16_none_leaf", dict(batch=16, remat=False, policy="full",
+                               leaf=True)),
+        ("b24_none_leaf", dict(batch=24, remat=False, policy="full",
+                               leaf=True)),
+        ("b32_none_leaf", dict(batch=32, remat=False, policy="full",
+                               leaf=True)),
+        ("b16x2_none_leaf", dict(batch=16, remat=False, policy="full",
+                                 accum=2, leaf=True)),
     ]
     if len(sys.argv) > 2:                  # run a subset by name
         names = set(sys.argv[2].split(","))

@@ -11,38 +11,14 @@ Usage: python tools/sweep_flash.py
 
 from __future__ import annotations
 
-import sys
-import time
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-sys.path.insert(0, ".")
+from _timing import sync as _sync, time_steps as _time  # noqa: E402 (sets sys.path)
 
 from apex_tpu.ops.flash_attention import (flash_attention,          # noqa: E402
                                           flash_attention_reference)
-
-
-def _sync(x):
-    leaf = jax.tree_util.tree_leaves(x)[0]
-    np.asarray(jax.device_get(leaf[(0,) * leaf.ndim]))
-    return x
-
-
-def _time(fn, args, warmup=2, iters=8, rounds=3):
-    for _ in range(warmup):
-        out = fn(*args)
-    _sync(out)
-    times = []
-    for _ in range(rounds):
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            out = fn(*args)
-        _sync(out)
-        times.append((time.perf_counter() - t0) / iters)
-    times.sort()
-    return times[len(times) // 2]
 
 
 def grad_fn(attn, causal):
