@@ -547,7 +547,7 @@ def flash_attention_reference(q, k, v, causal=False, softmax_scale=None,
 
 
 def flash_attention(q, k, v, causal=False, softmax_scale=None,
-                    kv_seqlens=None, block_q=512, block_k=512,
+                    kv_seqlens=None, block_q=1024, block_k=1024,
                     dropout=0.0, dropout_seed=None):
     """Fused attention over ``(batch, heads, seq, head_dim)`` operands.
 
@@ -587,13 +587,16 @@ def flash_attention(q, k, v, causal=False, softmax_scale=None,
         kv_seqlens = jnp.full((b,), sk, jnp.int32)
     seed = jnp.reshape(jnp.asarray(
         0 if dropout_seed is None else dropout_seed, jnp.int32), (1,))
-    # big default blocks amortize Mosaic grid-step overhead (the
-    # (128,128) default cost ~2x wall-clock at seq 1024 on v5e); pick
-    # the largest candidate that doesn't inflate sequence padding, so
-    # arbitrary lengths (e.g. 640) don't round up to a whole 512 block
+    # big default blocks amortize Mosaic grid-step overhead: the
+    # round-5 on-chip sweep (tools/sweep_flash.py) has (1024,1024)
+    # beating (512,512) by ~12% at seq 1024/2048 fwd+bwd and (512,512)
+    # optimal at seq 512 — grid-step overhead dominates the causal
+    # block-skip saving.  Pick the largest candidate that divides the
+    # padded sequence, so arbitrary lengths (e.g. 640) don't inflate
+    # padding to a whole large block.
     def _fit(requested, s):
         s_pad = _round_up(s, 128)
-        for cand in (requested, 384, 256, 128):
+        for cand in (requested, 512, 384, 256, 128):
             if cand <= requested and s_pad % cand == 0:
                 return cand
         return min(requested, s_pad)

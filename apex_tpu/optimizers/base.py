@@ -262,6 +262,17 @@ class FusedOptimizer:
         new_params = jax.tree_util.tree_unflatten(treedef, new_p_leaves)
         return new_params, {"step": step_count, "buckets": new_buckets}
 
+    # -- shared helpers ----------------------------------------------------
+
+    @staticmethod
+    def _bias_corrections(hyper, step_count):
+        """Adam-family ``1 - beta^t`` terms (1.0 when disabled)."""
+        beta1, beta2 = hyper["betas"]
+        if hyper["bias_correction"]:
+            t = step_count.astype(jnp.float32)
+            return 1.0 - beta1 ** t, 1.0 - beta2 ** t
+        return 1.0, 1.0
+
     # -- subclass hooks ----------------------------------------------------
 
     def _init_bucket(self, info: BucketInfo) -> dict:

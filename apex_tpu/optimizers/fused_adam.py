@@ -51,14 +51,6 @@ class FusedAdam(FusedOptimizer):
             noop_flag=noop, block_rows=self.block_rows)
         return p_new, {"m": m_new, "v": v_new}
 
-    @staticmethod
-    def _bias_corrections(hyper, step_count):
-        beta1, beta2 = hyper["betas"]
-        if hyper["bias_correction"]:
-            t = step_count.astype(jnp.float32)
-            return 1.0 - beta1 ** t, 1.0 - beta2 ** t
-        return 1.0, 1.0
-
     # -- per-leaf (bucketed=False) layout -----------------------------------
 
     def _init_leaves(self, info, ps):

@@ -58,14 +58,6 @@ class FusedLAMB(FusedOptimizer):
         clip = jnp.where(gnorm > max_norm, max_norm / gnorm, 1.0)
         return {"global_grad_clip": clip}
 
-    @staticmethod
-    def _bias_corrections(hyper, step_count):
-        beta1, beta2 = hyper["betas"]
-        if hyper["bias_correction"]:
-            t = step_count.astype(_f32)
-            return 1.0 - beta1 ** t, 1.0 - beta2 ** t
-        return 1.0, 1.0
-
     def _update_bucket(self, info, g, p, st, hyper, step_count, grad_scale,
                        noop, extras):
         beta1, beta2 = hyper["betas"]

@@ -424,3 +424,40 @@ class TestRound4SurfacesOnChip:
                                    rtol=2e-3, atol=2e-4)
         np.testing.assert_allclose(np.asarray(gw), np.asarray(rw),
                                    rtol=2e-3, atol=2e-4)
+
+
+class TestPerfGuard:
+    """Round-5 regression armor (VERDICT r4 item 8): the headline bench
+    step must not silently give back its measured best.  Margin is wide
+    (30%) because tunnel timing drifts between sessions; a real
+    regression (the packed-optimizer or remat tax returning) costs
+    ~45-90%, which this still catches."""
+
+    MARGIN = 1.30
+
+    def _recorded(self, key):
+        import json
+        import pathlib
+
+        root = pathlib.Path(__file__).resolve().parents[1]
+        return json.loads((root / "BASELINE.json").read_text())[
+            "recorded_best"][key]
+
+    def test_bert_headline_step_time(self):
+        import sys
+        import pathlib
+
+        root = pathlib.Path(__file__).resolve().parents[1]
+        sys.path.insert(0, str(root))
+        import bench
+
+        run, args, _, _, _ = bench._make_bert_lamb_step(
+            16, 2, remat=False, bucketed=False)
+        # odd round count: times[len//2] is a true median (2 rounds
+        # would return the slower one and flake on tunnel drift)
+        dt = bench._time_steps(run, args, warmup=1, iters=4, rounds=3)
+        best = self._recorded("bert_b16x2_none_perleaf_step_s")
+        assert dt < best * self.MARGIN, (
+            f"BERT headline step regressed: {dt * 1e3:.1f} ms vs recorded "
+            f"best {best * 1e3:.1f} ms (margin {self.MARGIN}x) — see "
+            "BASELINE.json recorded_best and BENCH_r05")
