@@ -22,6 +22,17 @@ the function here is the plain mapping composition
 (``gradient_accumulation_fusion``'s fp32 main-grad accumulation is likewise
 an XLA fusion).  ``sequence_parallel_enabled`` swaps the TP-edge collectives
 for the gather/reduce-scatter pair along the sequence (first) dim.
+
+Compiled evidence (not just assertion):
+``tests/test_on_chip.py::TestScheduledCollectiveEvidence`` AOT-compiles this
+block's grad for a real v5e:2x2 topology and pins, on the scheduled TPU
+module, that (a) the psums lower to ICI ring all-reduces, (b) XLA's
+combiner merges the per-weight gradient psums into ONE bucketed all-reduce
+(the flattened-bucket allreduce apex DDP hand-rolls), and (c) the schedule
+interleaves async data movement with compute fusions.  (TPU HLO keeps
+all-reduce synchronous as an instruction — the ICI pipelining lives inside
+the ring emitter — so start/done-style overlap shows up in the emitter
+strategy and the async copy/slice pairs, not as split collective ops.)
 """
 
 from __future__ import annotations

@@ -461,3 +461,116 @@ class TestPerfGuard:
             f"BERT headline step regressed: {dt * 1e3:.1f} ms vs recorded "
             f"best {best * 1e3:.1f} ms (margin {self.MARGIN}x) — see "
             "BASELINE.json recorded_best and BENCH_r05")
+
+
+class TestScheduledCollectiveEvidence:
+    """VERDICT r4 item 5: pin the 'XLA does the overlap/bucketing' claims
+    (transformer/tensor_parallel/layers.py module docstring) with
+    compiled evidence instead of assertion.
+
+    One real chip cannot EXECUTE a 4-device program, but the axon AOT
+    compiler can COMPILE for a real v5e:2x2 topology
+    (jax.experimental.topologies); ``compiled.as_text()`` is the
+    post-scheduling TPU module.  TPU HLO keeps all-reduce as one
+    synchronous instruction (the ICI pipelining lives inside the ring
+    emitter), so the checkable facts are:
+
+    * TP psums lower to ``all-reduce`` with an ICI RING strategy;
+    * the backward's per-weight gradient psums are COMBINED into one
+      bucketed all-reduce (apex DDP's flattened-bucket allreduce,
+      performed by XLA's combiner);
+    * the schedule interleaves async data movement (slice/copy
+      start..done) with compute fusions — at least one async pair has
+      compute scheduled between start and done.
+    """
+
+    def _compiled_tp_block_text(self):
+        from jax.experimental import topologies
+        from jax.sharding import Mesh, NamedSharding
+        from jax.sharding import PartitionSpec as P
+        from jax import shard_map
+
+        from apex_tpu.transformer import tensor_parallel as tp
+
+        try:
+            topo = topologies.get_topology_desc("v5e:2x2", platform="tpu")
+        except Exception as e:  # noqa: BLE001
+            pytest.skip(f"no AOT topology compiler here: {e}")
+        mesh = Mesh(np.array(topo.devices[:4]).reshape(2, 2),
+                    ("data", "model"))
+
+        col = tp.ColumnParallelLinear(1024, 4096, gather_output=False,
+                                      world_size=2, axis_name="model")
+        row = tp.RowParallelLinear(4096, 1024, input_is_parallel=True,
+                                   world_size=2, axis_name="model")
+
+        def block(p, x):
+            h, _ = col(p["c"], x)
+            h = jax.nn.gelu(h, approximate=True)
+            y, _ = row(p["r"], h)
+            h2, _ = col(p["c2"], y)
+            h2 = jax.nn.gelu(h2, approximate=True)
+            y2, _ = row(p["r2"], h2)
+            return jnp.sum(y2.astype(jnp.float32))
+
+        def grad_fn(p, x):
+            return jax.grad(block, argnums=0)(p, x)
+
+        cspec = {"weight": P("model", None), "bias": P("model")}
+        rspec = {"weight": P(None, "model"), "bias": P()}
+        pspec = {"c": cspec, "r": rspec, "c2": cspec, "r2": rspec}
+        f = shard_map(grad_fn, mesh=mesh,
+                      in_specs=(pspec, P("data", None)), out_specs=pspec)
+
+        def sds(shape, spec):
+            return jax.ShapeDtypeStruct(
+                shape, jnp.bfloat16, sharding=NamedSharding(mesh, spec))
+
+        p = {k: {"weight": sds((4096, 1024) if k.startswith("c")
+                               else (1024, 4096), pspec[k]["weight"]),
+                 "bias": sds((4096,) if k.startswith("c") else (1024,),
+                             pspec[k]["bias"])}
+             for k in ("c", "r", "c2", "r2")}
+        x = sds((512, 1024), P("data", None))
+        return jax.jit(f).lower(p, x).compile().as_text()
+
+    def test_ring_collectives_bucketed_allreduce_and_async_interleave(self):
+        import re
+
+        txt = self._compiled_tp_block_text()
+
+        # (1) psum -> all-reduce on an ICI ring (whole lines: the
+        # combined op's result-tuple dtypes precede the op name)
+        ars = re.findall(r"[^\n]*= [^\n]*all-reduce\([^\n]*", txt)
+        assert ars, "no all-reduce in the compiled TP block"
+        assert any("RingStrategy" in a or "StrategyRing" in a
+                   for a in ars), "no ICI ring strategy on any all-reduce"
+
+        # (2) the data-parallel wgrad psums are COMBINED: one all-reduce
+        # carries multiple weight-shaped operands (XLA's combiner = the
+        # bucketed flattened allreduce apex DDP hand-rolls)
+        assert any(a.count("bf16[") >= 4 for a in ars), (
+            "gradient all-reduces were not combined/bucketed")
+
+        # (3) async data movement interleaved with compute: some
+        # start..done pair — matched BY NAME, the done op consumes its
+        # start op as an operand — has a fusion scheduled between (a
+        # loose cross-pair regex would pass even on a fully serialized
+        # schedule)
+        lines = txt.splitlines()
+        interleaved = False
+        for i, ln in enumerate(lines):
+            m = re.match(r"\s*(%\S*-start\S*) = ", ln)
+            if not m:
+                continue
+            name = m.group(1)
+            for j in range(i + 1, len(lines)):
+                if "-done" in lines[j] and (
+                        name + ")" in lines[j] or name + "," in lines[j]):
+                    if any("%fusion" in lines[k] for k in range(i + 1, j)):
+                        interleaved = True
+                    break
+            if interleaved:
+                break
+        assert interleaved, (
+            "no async start/compute/done interleaving in the schedule")

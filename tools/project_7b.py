@@ -21,6 +21,13 @@ Method (written into BASELINE.md):
 * tokens/sec/chip = mb*s*M / (T_stage*(M+pp-1) + eps) / 1 chip-of-64,
   where each of the 64 chips holds one (tp, pp) shard and dp=4 scales
   tokens and chips together (cancels).
+
+Known error term this script CANNOT measure on one chip: the TP
+all-reduces inside each layer (2 psums fwd + 2 bwd of the (mb, s, h)
+activation over the 4-chip ring, ~26 ms/tick serial worst case vs the
+~60 ms measured compute).  BASELINE.md carries the projection as a
+range whose lower bound charges them fully serial and whose upper
+bound assumes full overlap.
 """
 
 from __future__ import annotations
