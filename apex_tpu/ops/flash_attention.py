@@ -132,7 +132,7 @@ def _sds(shape, dtype, like):
 # forward kernel
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(causal, scale, rate, sq, block_q, block_k,
+def _fwd_kernel(causal, scale, rate, sq, block_q, block_k, masked,
                 len_ref, seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr):
     b = pl.program_id(0)
@@ -154,19 +154,28 @@ def _fwd_kernel(causal, scale, rate, sq, block_q, block_k,
         k = k_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=_f32) * scale
-        q_pos = qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        k_pos = ki * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        valid = k_pos < len_ref[b]
-        if causal:
-            valid = valid & (k_pos <= q_pos)
-        s = jnp.where(valid, s, _MASK)
+        if masked:
+            # ``masked`` is static: dense full-length non-causal calls
+            # (the BERT shape) skip the iota/compare/select passes
+            # (same-window A/B on v5e measures this neutral-to-slightly
+            # -positive — Mosaic overlaps the VPU mask work with the
+            # dots — kept because it is free specialization, mirroring
+            # the reference fmha's seqlen-templated kernels)
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            valid = k_pos < len_ref[b]
+            if causal:
+                valid = valid & (k_pos <= q_pos)
+            s = jnp.where(valid, s, _MASK)
 
         m_prev = m_scr[:, :1]
         m_cur = jnp.maximum(jnp.max(s, axis=1, keepdims=True), m_prev)
         alpha = jnp.exp(m_prev - m_cur)
-        p = jnp.where(valid, jnp.exp(s - m_cur), 0.0)
+        p = jnp.exp(s - m_cur)
+        if masked:
+            p = jnp.where(valid, p, 0.0)
         # l accumulates the UNDROPPED p (softmax normalizes pre-dropout);
         # the keep/(1-rate) factor touches only the PV matmul
         l_cur = alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
@@ -201,12 +210,16 @@ def _fwd_kernel(causal, scale, rate, sq, block_q, block_k,
 # backward kernels
 # ---------------------------------------------------------------------------
 
-def _recompute_p(causal, scale, qi, ki, block_q, block_k, kv_len,
+def _recompute_p(causal, scale, qi, ki, block_q, block_k, masked, kv_len,
                  q, k, lse):
     """p = exp(q k^T * scale - lse) with the forward's mask re-applied.
-    ``q``/``k`` native dtype; accumulation f32 (MXU-rate dots)."""
+    ``q``/``k`` native dtype; accumulation f32 (MXU-rate dots).
+    ``masked`` static False (dense full-length non-causal) skips the
+    mask recompute, matching the forward."""
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=_f32) * scale
+    if not masked:
+        return jnp.exp(s - lse), None
     q_pos = qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
     k_pos = ki * block_k + jax.lax.broadcasted_iota(
@@ -218,7 +231,7 @@ def _recompute_p(causal, scale, qi, ki, block_q, block_k, kv_len,
     return p, valid
 
 
-def _dq_kernel(causal, scale, rate, sq, block_q, block_k,
+def _dq_kernel(causal, scale, rate, sq, block_q, block_k, masked,
                len_ref, seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                delta_ref, dq_ref, dq_scr):
     b = pl.program_id(0)
@@ -236,7 +249,7 @@ def _dq_kernel(causal, scale, rate, sq, block_q, block_k,
         do = do_ref[0]
         lse = lse_ref[0]                      # (block_q, 1)
         p, _ = _recompute_p(causal, scale, qi, ki, block_q, block_k,
-                            len_ref[b], q, k, lse)
+                            masked, len_ref[b], q, k, lse)
         dp = jax.lax.dot_general(do, v_ref[0], (((1,), (1,)), ((), ())),
                                  preferred_element_type=_f32)
         if rate > 0.0:
@@ -262,7 +275,7 @@ def _dq_kernel(causal, scale, rate, sq, block_q, block_k,
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(causal, scale, rate, sq, block_q, block_k,
+def _dkv_kernel(causal, scale, rate, sq, block_q, block_k, masked,
                 len_ref, seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                 delta_ref, dk_ref, dv_ref, dk_scr, dv_scr):
     b = pl.program_id(0)
@@ -281,13 +294,15 @@ def _dkv_kernel(causal, scale, rate, sq, block_q, block_k,
         do = do_ref[0]
         lse = lse_ref[0]                      # (block_q, 1)
         p, valid = _recompute_p(causal, scale, qi, ki, block_q, block_k,
-                                len_ref[b], q, k, lse)
-        # zero padded q rows: their lse/delta are garbage and p.T @ do
-        # would poison every dk/dv row (forward never reads them — it
-        # slices; the backward reduces over them)
-        q_pos = qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        p = jnp.where(q_pos < sq, p, 0.0)
+                                masked, len_ref[b], q, k, lse)
+        if masked:
+            # zero padded q rows: their lse/delta are garbage and
+            # p.T @ do would poison every dk/dv row (forward never
+            # reads them — it slices; the backward reduces over them).
+            # ``masked`` is True whenever the q extent is padded.
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            p = jnp.where(q_pos < sq, p, 0.0)
         if rate > 0.0:
             # same (seed, b, qi, ki) stream as the forward — note this
             # kernel's grid is (B, k, q), so the logical (qi, ki) pair is
@@ -361,13 +376,13 @@ def _compiler_params():
 
 
 def _flash_fwd_impl(q, k, v, kv_lens, seed, causal, scale, rate,
-                    block_q, block_k):
+                    block_q, block_k, masked):
     """q,k,v: (B, s, d) padded inputs; returns (o, lse) padded."""
     B, sq, d_pad = q.shape
     sk = k.shape[1]
     nq, nk = sq // block_q, sk // block_k
     kernel = functools.partial(_fwd_kernel, causal, scale, rate, sq,
-                               block_q, block_k)
+                               block_q, block_k, masked)
     o, lse = pl.pallas_call(
         kernel,
         grid=(B, nq, nk),
@@ -390,7 +405,7 @@ def _flash_fwd_impl(q, k, v, kv_lens, seed, causal, scale, rate,
 
 
 def _flash_bwd_impl(q, k, v, o, lse, do, kv_lens, seed, causal, scale,
-                    rate, block_q, block_k, true_sq):
+                    rate, block_q, block_k, true_sq, masked):
     """``true_sq`` is the UNPADDED query length — the dkv kernel's
     padded-row guard must compare against it, not the padded extent."""
     B, sq, d_pad = q.shape
@@ -400,7 +415,7 @@ def _flash_bwd_impl(q, k, v, o, lse, do, kv_lens, seed, causal, scale,
                     keepdims=True)                              # (B, sq, 1)
 
     dq_kernel = functools.partial(_dq_kernel, causal, scale, rate, sq,
-                                  block_q, block_k)
+                                  block_q, block_k, masked)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(B, nq, nk),
@@ -421,7 +436,7 @@ def _flash_bwd_impl(q, k, v, o, lse, do, kv_lens, seed, causal, scale,
 
     # dk/dv: swap the roles — grid dim 1 walks k blocks, dim 2 walks q
     dkv_kernel = functools.partial(_dkv_kernel, causal, scale, rate,
-                                   true_sq, block_q, block_k)
+                                   true_sq, block_q, block_k, masked)
     q_spec = pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, j, 0),
                           memory_space=pltpu.VMEM)
     k_spec = pl.BlockSpec((1, block_k, d_pad), lambda b, i, j: (b, i, 0),
@@ -448,11 +463,11 @@ def _flash_bwd_impl(q, k, v, o, lse, do, kv_lens, seed, causal, scale,
 # custom-VJP wrapper over (b, h, s, d)
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
 def _flash(q, k, v, kv_seqlens, seed, causal, scale, block_q, block_k,
-           rate):
+           rate, masked):
     out, _ = _flash_vjp_fwd(q, k, v, kv_seqlens, seed, causal, scale,
-                            block_q, block_k, rate)
+                            block_q, block_k, rate, masked)
     return out
 
 
@@ -470,16 +485,16 @@ def _flatten(q, k, v, kv_seqlens, block_q, block_k):
 
 
 def _flash_vjp_fwd(q, k, v, kv_seqlens, seed, causal, scale, block_q,
-                   block_k, rate):
+                   block_k, rate, masked):
     b, h, sq, d = q.shape
     q3, k3, v3, lens = _flatten(q, k, v, kv_seqlens, block_q, block_k)
     o3, lse = _flash_fwd_impl(q3, k3, v3, lens, seed, causal, scale,
-                              rate, block_q, block_k)
+                              rate, block_q, block_k, masked)
     out = o3[:, :sq, :d].reshape(b, h, sq, d)
     return out, (q, k, v, kv_seqlens, seed, o3, lse)
 
 
-def _flash_vjp_bwd(causal, scale, block_q, block_k, rate, res, g):
+def _flash_vjp_bwd(causal, scale, block_q, block_k, rate, masked, res, g):
     q, k, v, kv_seqlens, seed, o3, lse = res
     b, h, sq, d = q.shape
     sk = k.shape[2]
@@ -487,7 +502,7 @@ def _flash_vjp_bwd(causal, scale, block_q, block_k, rate, res, g):
     do3 = _pad_qkv(g.reshape(b * h, sq, d), q3.shape[1], q3.shape[2])
     dq3, dk3, dv3 = _flash_bwd_impl(q3, k3, v3, o3, lse, do3, lens, seed,
                                     causal, scale, rate, block_q, block_k,
-                                    sq)
+                                    sq, masked)
     dq = dq3[:, :sq, :d].reshape(b, h, sq, d).astype(q.dtype)
     dk = dk3[:, :sk, :d].reshape(b, h, sk, d).astype(k.dtype)
     dv = dv3[:, :sk, :d].reshape(b, h, sk, d).astype(v.dtype)
@@ -583,6 +598,7 @@ def flash_attention(q, k, v, causal=False, softmax_scale=None,
                                       rate).reshape(b, h, sq, sk)
         return flash_attention_reference(q, k, v, causal, scale,
                                          kv_seqlens, dropout_mask=mask)
+    has_lens = kv_seqlens is not None
     if kv_seqlens is None:
         kv_seqlens = jnp.full((b,), sk, jnp.int32)
     seed = jnp.reshape(jnp.asarray(
@@ -602,5 +618,10 @@ def flash_attention(q, k, v, causal=False, softmax_scale=None,
         return min(requested, s_pad)
     block_q = _fit(int(block_q), sq)
     block_k = _fit(int(block_k), sk)
+    # static no-mask fast path: dense full-length non-causal attention
+    # with block-aligned extents (post-_fit) needs NO iota/compare/
+    # select passes in any of the three kernels (zero-padding of
+    # head_dim is harmless: padded lanes contribute 0 to every dot)
+    masked = bool(causal or has_lens or sq % block_q or sk % block_k)
     return _flash(q, k, v, kv_seqlens, seed, bool(causal), scale,
-                  block_q, block_k, rate)
+                  block_q, block_k, rate, masked)

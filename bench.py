@@ -226,6 +226,34 @@ def _paired_mfu_passes(run, args, tokens_per_step, flops_per_token,
 # model legs
 # ---------------------------------------------------------------------------
 
+def _accumulated_grads(loss_fn, params, tokens, labels, accum,
+                       grad_dtype=None):
+    """Mean loss + mean grads over ``accum`` leading-axis microbatches
+    via lax.scan, accumulating in f32; ``grad_dtype`` casts the final
+    grads (bf16 under O2 — the cotangent dtype the optimizer expects).
+    Single source for the BERT and GPT accumulation legs (and imported
+    by tools/sweep_gpt.py) so the accumulation numerics cannot drift
+    between them."""
+    if accum == 1:
+        return jax.value_and_grad(loss_fn)(params, tokens[0], labels[0])
+
+    def mb(carry, tl):
+        tk, lb = tl
+        l, g = jax.value_and_grad(loss_fn)(params, tk, lb)
+        acc_l, acc_g = carry
+        g = jax.tree_util.tree_map(
+            lambda a, b: a + b.astype(jnp.float32), acc_g, g)
+        return (acc_l + l, g), None
+
+    zero = (jnp.zeros(()),
+            jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params))
+    (loss, grads), _ = jax.lax.scan(mb, zero, (tokens, labels))
+    inv = 1.0 / accum
+    cast = (lambda g: g * inv) if grad_dtype is None else (
+        lambda g: (g * inv).astype(grad_dtype))
+    return loss * inv, jax.tree_util.tree_map(cast, grads)
+
 def _make_bert_lamb_step(batch, accum, *, remat, bucketed, optimizer="lamb"):
     """The BASELINE row-1 workload: BERT-large MLM + FusedLAMB + amp O2
     (bf16 model params, fp32 masters, keep-norm-fp32), global batch
@@ -279,23 +307,8 @@ def _make_bert_lamb_step(batch, accum, *, remat, bucketed, optimizer="lamb"):
     labels = jnp.asarray(labels)
 
     def grads_of(params, tokens, labels):
-        if accum == 1:
-            return jax.value_and_grad(state.apply_fn)(params, tokens[0],
-                                                      labels[0])
-
-        def mb(carry, tl):
-            tk, lb = tl
-            l, g = jax.value_and_grad(state.apply_fn)(params, tk, lb)
-            acc_l, acc_g = carry
-            return (acc_l + l,
-                    jax.tree_util.tree_map(jnp.add, acc_g, g)), None
-        zero = (jnp.zeros(()),
-                jax.tree_util.tree_map(
-                    lambda p: jnp.zeros(p.shape, jnp.float32), params))
-        (loss, grads), _ = jax.lax.scan(mb, zero, (tokens, labels))
-        inv = 1.0 / accum
-        return loss * inv, jax.tree_util.tree_map(
-            lambda g: (g * inv).astype(jnp.bfloat16), grads)
+        return _accumulated_grads(state.apply_fn, params, tokens, labels,
+                                  accum, grad_dtype=jnp.bfloat16)
 
     if optimizer == "lamb":
         @functools.partial(jax.jit, donate_argnums=(0, 1))
@@ -383,13 +396,15 @@ def bench_gpt_train_step():
     from apex_tpu.models.gpt import GPTConfig, GPTModel
     from apex_tpu.optimizers import FusedAdam
 
-    # measured best (tools/sweep_gpt.py): batch 8, NO remat, per-leaf
-    # FusedAdam; the fused logit-free LM head keeps the (b*s, vocab)
-    # logits out of HBM, which is what lets no-remat fit at all
+    # measured best (tools/sweep_gpt.py): micro-batch 8 x 2 gradient
+    # accumulation (global batch 16, the same 16 Ktok/step as rounds
+    # 1-4), NO remat, per-leaf FusedAdam; the fused logit-free LM head
+    # keeps the (b*s, vocab) logits out of HBM, which is what lets
+    # no-remat fit at all
     cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
                     num_attention_heads=16, max_seq_len=1024, remat=False,
                     dtype=jnp.bfloat16)
-    batch, seq = 8, 1024
+    batch, seq, accum = 8, 1024, 2
     model = GPTModel(cfg)
     params = model.init_params(jax.random.PRNGKey(0))
     n_params = sum(int(np.prod(p.shape))
@@ -397,13 +412,15 @@ def bench_gpt_train_step():
     adam = FusedAdam(lr=1e-4, bucketed=False)
     opt_state = adam.init(params)
     rng = np.random.RandomState(0)
-    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
-    targets = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size,
+                                     (accum, batch, seq)))
+    targets = jnp.asarray(rng.randint(0, cfg.vocab_size,
+                                      (accum, batch, seq)))
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def train_step(params, opt_state, tokens, targets):
-        loss, grads = jax.value_and_grad(model.loss)(params, tokens,
-                                                     targets)
+        loss, grads = _accumulated_grads(model.loss, params, tokens,
+                                         targets, accum)
         new_params, new_opt = adam.step(grads, params, opt_state)
         return loss, new_params, new_opt
 
@@ -418,10 +435,11 @@ def bench_gpt_train_step():
     # PaLM-style accounting: 6*N per token (fwd+bwd) + attention term
     flops_per_token = 6 * n_params + 12 * cfg.num_layers * cfg.hidden_size \
         * seq
-    out = _paired_mfu_passes(run, (tokens, targets), batch * seq,
-                             flops_per_token)
-    return {"n_params": n_params, "batch": batch, "seq": seq,
-            "remat": "none", "optimizer_layout": "per_leaf", **out}
+    out = _paired_mfu_passes(run, (tokens, targets),
+                             accum * batch * seq, flops_per_token)
+    return {"n_params": n_params, "batch": batch, "accum": accum,
+            "seq": seq, "remat": "none", "optimizer_layout": "per_leaf",
+            **out}
 
 
 # ---------------------------------------------------------------------------

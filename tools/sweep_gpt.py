@@ -14,7 +14,7 @@ import numpy as np
 from _timing import sync as _sync, time_steps as _time  # noqa: E402
 
 
-def make_step(batch, remat, policy, leaf):
+def make_step(batch, remat, policy, leaf, accum=1):
     from apex_tpu.models.gpt import GPTConfig, GPTModel
     from apex_tpu.optimizers import FusedAdam
 
@@ -27,13 +27,17 @@ def make_step(batch, remat, policy, leaf):
     adam = FusedAdam(lr=1e-4, bucketed=not leaf)
     opt_state = adam.init(params)
     rng = np.random.RandomState(0)
-    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
-    targets = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size,
+                                     (accum, batch, seq)))
+    targets = jnp.asarray(rng.randint(0, cfg.vocab_size,
+                                      (accum, batch, seq)))
+
+    from bench import _accumulated_grads  # shared accumulation numerics
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def train_step(params, opt_state, tokens, targets):
-        loss, grads = jax.value_and_grad(model.loss)(params, tokens,
-                                                     targets)
+        loss, grads = _accumulated_grads(model.loss, params, tokens,
+                                         targets, accum)
         new_params, new_opt = adam.step(grads, params, opt_state)
         return loss, new_params, new_opt
 
@@ -45,7 +49,7 @@ def make_step(batch, remat, policy, leaf):
                                                     targets)
         return loss
 
-    return run, (tokens, targets), batch * seq
+    return run, (tokens, targets), accum * batch * seq
 
 
 def main():
@@ -60,6 +64,10 @@ def main():
                                leaf=True)),
         ("b16_dots", dict(batch=16, remat=True, policy="dots",
                           leaf=False)),
+        ("b8x2_none_leaf", dict(batch=8, remat=False, policy="full",
+                                leaf=True, accum=2)),
+        ("b8x4_none_leaf", dict(batch=8, remat=False, policy="full",
+                                leaf=True, accum=4)),
     ]
     if len(sys.argv) > 1:
         names = set(sys.argv[1].split(","))
