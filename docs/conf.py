@@ -14,7 +14,7 @@ sys.path.insert(0, os.path.abspath(".."))
 
 project = "apex-tpu"
 author = "apex-tpu contributors"
-release = "0.3.0"
+release = "0.4.0"
 
 extensions = [
     "sphinx.ext.autodoc",
