@@ -27,7 +27,7 @@ Two execution layouts (``bucketed`` ctor flag):
   buffers, so the packed path pays a pack (concat) + unpack (slice)
   HBM round trip per step that per-leaf fusion never performs —
   measured ~150 ms vs ~40 ms for the BERT-large LAMB census on v5e
-  (bench.py ``fused_adam_vs_optax`` / BENCH_r05).  apex has no
+  (bench.py ``fused_adam_vs_optax`` / BENCH_r05_local.json).  apex has no
   equivalent switch because CUDA launch overhead forces fusion the
   other way (see SURVEY §3.2); on TPU the launch-count argument
   inverts, so the idiomatic default for SINGLE-CHIP model training is

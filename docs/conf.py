@@ -14,7 +14,7 @@ sys.path.insert(0, os.path.abspath(".."))
 
 project = "apex-tpu"
 author = "apex-tpu contributors"
-release = "0.4.0"
+from apex_tpu._version import __version__ as release  # single source
 
 extensions = [
     "sphinx.ext.autodoc",

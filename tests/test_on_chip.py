@@ -460,7 +460,7 @@ class TestPerfGuard:
         assert dt < best * self.MARGIN, (
             f"BERT headline step regressed: {dt * 1e3:.1f} ms vs recorded "
             f"best {best * 1e3:.1f} ms (margin {self.MARGIN}x) — see "
-            "BASELINE.json recorded_best and BENCH_r05")
+            "BASELINE.json recorded_best and BENCH_r05_local.json")
 
 
 class TestScheduledCollectiveEvidence:
