@@ -432,3 +432,25 @@ class TestPerLeafLayout:
         with pytest.raises(ValueError, match="bucketed"):
             DistributedFusedAdam(lr=1e-3, world_size=2, axis_name="data",
                                  bucketed=False)
+
+    def test_grad_scale_parity(self, rng):
+        """amp's fused unscaling (grad_scale=1/loss_scale) must walk the
+        same trajectory in both layouts AND match stepping on pre-divided
+        grads — LAMB is the interesting case because grad_scale also
+        enters the global-norm clip (the third arm catches a shared-code
+        bug that drops/double-applies grad_scale in both layouts)."""
+        params = make_params(rng)
+        packed = FusedLAMB(lr=1e-2)
+        leaf = FusedLAMB(lr=1e-2, bucketed=False)
+        unscaled = FusedLAMB(lr=1e-2, bucketed=False)
+        ps, ss = params, packed.init(params)
+        pl_, sl = params, leaf.init(params)
+        pu, su = params, unscaled.init(params)
+        for _ in range(3):
+            grads = make_grads(rng, params, scale=128.0)  # "scaled" grads
+            pre = jax.tree_util.tree_map(lambda g: g / 128.0, grads)
+            ps, ss = packed.step(grads, ps, ss, grad_scale=1 / 128.0)
+            pl_, sl = leaf.step(grads, pl_, sl, grad_scale=1 / 128.0)
+            pu, su = unscaled.step(pre, pu, su)
+            tree_allclose(ps, pl_, rtol=1e-6, atol=1e-7)
+            tree_allclose(pl_, pu, rtol=1e-5, atol=1e-7)
