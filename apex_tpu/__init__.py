@@ -33,6 +33,7 @@ _SUBMODULES = (
     "contrib",
     "fp16_utils",
     "fused_dense",
+    "inference",
     "mlp",
     "models",
     "multi_tensor_apply",

@@ -1,0 +1,35 @@
+"""apex_tpu.inference — KV-cache decode + continuous-batching serving.
+
+The reference covers training only; this subsystem is the
+beyond-reference serving leg (ROADMAP "inference story").  Three layers:
+
+* :class:`KVCache` — a preallocated per-slot cache ring
+  ``(slots, layers, 2, max_seq, kv_heads, head_dim)`` with host-side
+  slot allocation and dtype control (bf16 cache, f32 attention
+  accumulation).
+* sampling — :class:`SamplingParams` / :func:`sample`: greedy,
+  temperature, top-k.
+* :class:`InferenceEngine` — continuous batching over the slot ring:
+  requests admit as slots free (one prefill each), then ride a single
+  batched ``decode_step`` whose batch dimension IS the slot table.
+  Per-row math is independent, so batched greedy decode is
+  token-identical to decoding each request alone.
+
+Model side: :meth:`apex_tpu.models.gpt.GPTModel.prefill` /
+``decode_step`` reuse the TP layers unchanged (serial and shard_map);
+the decode attention kernel is
+:func:`apex_tpu.ops.flash_attention.flash_attention_decode`.
+"""
+
+from apex_tpu.inference.engine import InferenceEngine, Request, Response
+from apex_tpu.inference.kv_cache import KVCache
+from apex_tpu.inference.sampling import SamplingParams, sample
+
+__all__ = [
+    "InferenceEngine",
+    "KVCache",
+    "Request",
+    "Response",
+    "SamplingParams",
+    "sample",
+]

@@ -1,0 +1,234 @@
+"""Continuous-batching serving engine for GPT decode.
+
+Orchestration is host-side and simple by design; the device work is two
+jitted programs — one prefill per prompt bucket and ONE batched
+``decode_step`` whose batch dimension is the cache slot table:
+
+* admission — while slots are free and requests are queued, each request
+  gets one prefill (prompt padded to a power-of-two bucket: causal
+  masking makes the pad rows inert) whose K/V lands in its slot and
+  whose last-position logits yield the first token (TTFT ends here).
+* decode — every step runs ALL slots through ``decode_step``; inactive
+  slots compute garbage that is never read (their writes land at stale
+  positions that the next prefill overwrites before any valid length
+  reaches them).  New requests admit between steps as slots free — no
+  batch drain, which is the point of continuous batching.
+* completion — eos / ``max_new_tokens`` / cache exhaustion free the
+  slot; a request past its ``deadline`` is EVICTED mid-flight with
+  whatever it has generated.
+
+Determinism: each decode row depends only on its own slot's cache and
+token (attention masks by per-row length, norms/linears are per-token),
+so greedy decode of a request inside any batch mix is token-identical to
+running it alone — asserted by the engine tests.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.inference.kv_cache import KVCache
+from apex_tpu.inference.sampling import SamplingParams, sample
+from apex_tpu.utils.platform import is_tpu_backend
+from apex_tpu.utils.profiling import ServingMetrics
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request.
+
+    ``deadline`` is an absolute value of the engine's ``clock`` (default
+    ``time.monotonic``); a request still running past it is evicted.
+    ``seed`` feeds the per-request sampling stream (stochastic modes
+    only) — streams are keyed by (seed, token index), never by batch
+    composition.
+    """
+    request_id: int
+    prompt: Sequence[int]
+    max_new_tokens: int = 16
+    eos_id: Optional[int] = None
+    sampling: SamplingParams = dataclasses.field(
+        default_factory=SamplingParams)
+    deadline: Optional[float] = None
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class Response:
+    """Completed (or evicted) request: ``tokens`` holds the generated
+    ids (including the eos token when one was emitted);
+    ``finish_reason`` is ``"eos"``, ``"length"`` (max_new_tokens or
+    cache row exhausted) or ``"evicted"`` (deadline)."""
+    request_id: int
+    prompt: List[int]
+    tokens: List[int]
+    finish_reason: str
+
+
+@dataclasses.dataclass
+class _Active:
+    request: Request
+    prompt_len: int
+    next_token: int        # fed to the next decode step
+    position: int          # absolute position next_token is written at
+    generated: List[int] = dataclasses.field(default_factory=list)
+
+
+class InferenceEngine:
+    """Continuous batching over a :class:`KVCache` slot ring."""
+
+    def __init__(self, model, params, *, max_slots: int = 8,
+                 max_seq: Optional[int] = None, cache_dtype=None,
+                 clock: Callable[[], float] = time.monotonic,
+                 metrics: Optional[ServingMetrics] = None,
+                 min_prompt_bucket: int = 8):
+        model._check_decode_supported()
+        cfg = model.cfg
+        self.model = model
+        self.params = params
+        self.cache = KVCache(max_slots, cfg.num_layers,
+                             max_seq or cfg.max_seq_len, cfg.local_heads,
+                             cfg.head_dim, cache_dtype or cfg.dtype)
+        self.clock = clock
+        self.metrics = metrics or ServingMetrics(clock)
+        self._min_bucket = min_prompt_bucket
+        self._queue: collections.deque = collections.deque()
+        self._active: dict = {}          # slot -> _Active
+        self._done: List[Response] = []
+        # the cache buffer threads through every step: donate it on TPU
+        # so XLA updates it in place (donation on CPU only warns)
+        donate = (2,) if is_tpu_backend() else ()
+        self._decode = jax.jit(model.decode_step, donate_argnums=donate)
+        self._prefill = jax.jit(model.prefill)
+
+    # -- request lifecycle ---------------------------------------------------
+
+    def submit(self, request: Request) -> None:
+        if not 0 < len(request.prompt) < self.cache.max_seq:
+            raise ValueError(
+                f"prompt length {len(request.prompt)} must be in "
+                f"(0, {self.cache.max_seq}) to leave room for decode")
+        self.metrics.request_submitted(request.request_id)
+        self._queue.append(request)
+
+    def _bucket(self, n: int) -> int:
+        b = self._min_bucket
+        while b < n:
+            b *= 2
+        return min(b, self.cache.max_seq)
+
+    def _sample(self, req: Request, logits_row, token_index: int) -> int:
+        if req.sampling.greedy:
+            return int(np.argmax(logits_row))
+        key = jax.random.fold_in(jax.random.PRNGKey(req.seed),
+                                 token_index)
+        return int(sample(jnp.asarray(logits_row), req.sampling, key))
+
+    def _finish(self, slot: int, st: _Active, reason: str) -> None:
+        self.cache.free(slot)
+        del self._active[slot]
+        self._done.append(Response(st.request.request_id,
+                                   list(st.request.prompt),
+                                   st.generated, reason))
+
+    def _maybe_finish(self, slot: int, st: _Active) -> bool:
+        req = st.request
+        if req.eos_id is not None and st.generated[-1] == req.eos_id:
+            self._finish(slot, st, "eos")
+        elif len(st.generated) >= req.max_new_tokens:
+            self._finish(slot, st, "length")
+        elif st.position >= self.cache.max_seq:
+            self._finish(slot, st, "length")      # cache row exhausted
+        else:
+            return False
+        return True
+
+    def _evict_expired(self) -> None:
+        now = self.clock()
+
+        def expired(req):
+            return req.deadline is not None and now >= req.deadline
+
+        for slot in [s for s, st in self._active.items()
+                     if expired(st.request)]:
+            self._finish(slot, self._active[slot], "evicted")
+        keep: collections.deque = collections.deque()
+        while self._queue:
+            req = self._queue.popleft()
+            if expired(req):
+                self._done.append(Response(req.request_id,
+                                           list(req.prompt), [],
+                                           "evicted"))
+            else:
+                keep.append(req)
+        self._queue = keep
+
+    def _admit(self) -> None:
+        while self._queue and self.cache.free_slots:
+            req = self._queue.popleft()
+            slot = self.cache.allocate()
+            plen = len(req.prompt)
+            toks = np.zeros((1, self._bucket(plen)), np.int32)
+            toks[0, :plen] = req.prompt
+            logits, kv = self._prefill(self.params, jnp.asarray(toks))
+            self.cache.write_prompt(slot, kv[:, :, 0], plen)
+            first = self._sample(req, np.asarray(logits[0, plen - 1]), 0)
+            self.metrics.first_token(req.request_id)
+            st = _Active(req, plen, next_token=first, position=plen,
+                         generated=[first])
+            self._active[slot] = st
+            self._maybe_finish(slot, st)
+
+    # -- the decode loop -----------------------------------------------------
+
+    def step(self) -> bool:
+        """One engine iteration: evict, admit, one batched decode step.
+        Returns True while there is (or may be) work left."""
+        self._evict_expired()
+        self._admit()
+        if not self._active:
+            return bool(self._queue)
+        n = self.cache.slots
+        tokens = np.zeros((n,), np.int32)
+        positions = np.zeros((n,), np.int32)
+        for slot, st in self._active.items():
+            tokens[slot] = st.next_token
+            positions[slot] = st.position
+        logits, self.cache.data = self._decode(
+            self.params, jnp.asarray(tokens), self.cache.data,
+            jnp.asarray(positions))
+        self.metrics.step(len(self._active), n)
+        logits_np = np.asarray(logits)
+        for slot in sorted(self._active):
+            st = self._active[slot]
+            self.cache.advance(slot)           # the fed token is cached now
+            tok = self._sample(st.request, logits_np[slot],
+                               len(st.generated))
+            self.metrics.token(st.request.request_id)
+            st.generated.append(tok)
+            st.next_token = tok
+            st.position += 1
+            self._maybe_finish(slot, st)
+        return bool(self._active or self._queue)
+
+    def run(self, max_steps: Optional[int] = None) -> List[Response]:
+        """Drive :meth:`step` until every submitted request completes
+        (or ``max_steps``); returns responses in completion order."""
+        steps = 0
+        while self._queue or self._active:
+            self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        return list(self._done)
+
+    @property
+    def completed(self) -> List[Response]:
+        return list(self._done)

@@ -1,0 +1,94 @@
+"""Preallocated KV-cache ring with slot allocation.
+
+One device array holds every sequence's cache:
+``(slots, layers, 2, max_seq, kv_heads, head_dim)`` — axis 2 is K/V.
+The slot axis doubles as the decode batch dimension, so admission is
+slot allocation and nothing ever reshapes or compacts: a freed slot's
+rows are simply overwritten by the next prompt.  The array itself is
+functional (reassigned on every write, aliased in place by XLA under
+donation on TPU); slot bookkeeping (free list, per-slot lengths) is
+host-side numpy, since the engine's control loop is host-driven.
+
+Dtype control: the cache is typically ``bfloat16`` (half the HBM of
+f32 — cache size, not FLOPs, bounds batch×context on an inference
+chip) while attention accumulates in f32 regardless
+(:func:`apex_tpu.ops.flash_attention.flash_attention_decode`).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class KVCache:
+    """Slot-table KV cache for continuous-batching decode."""
+
+    def __init__(self, slots: int, layers: int, max_seq: int,
+                 kv_heads: int, head_dim: int,
+                 dtype=jnp.bfloat16):
+        if slots <= 0 or max_seq <= 0:
+            raise ValueError("slots and max_seq must be positive")
+        self.data = jnp.zeros(
+            (slots, layers, 2, max_seq, kv_heads, head_dim), dtype)
+        self.lengths = np.zeros((slots,), np.int32)
+        # LIFO free list popping the lowest slot first keeps tests and
+        # traces readable; correctness doesn't depend on the order
+        self._free = list(range(slots - 1, -1, -1))
+
+    @property
+    def slots(self) -> int:
+        return self.data.shape[0]
+
+    @property
+    def max_seq(self) -> int:
+        return self.data.shape[3]
+
+    @property
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    @property
+    def active_slots(self) -> int:
+        return self.slots - len(self._free)
+
+    def allocate(self) -> Optional[int]:
+        """Claim a free slot id, or None when fully occupied."""
+        if not self._free:
+            return None
+        return self._free.pop()
+
+    def free(self, slot: int) -> None:
+        """Return ``slot`` to the pool.  Its rows are left in place —
+        the next prompt overwrites them, and until then no valid length
+        references them."""
+        if slot in self._free:
+            raise ValueError(f"slot {slot} is already free")
+        self.lengths[slot] = 0
+        self._free.append(slot)
+
+    def write_prompt(self, slot: int, kv, length: int) -> None:
+        """Install a prefilled prompt into ``slot``.
+
+        ``kv``: ``(layers, 2, s, kv_heads, head_dim)`` from
+        :meth:`~apex_tpu.models.gpt.GPTModel.prefill` (one sequence),
+        cast here to the cache dtype.  ``s`` may exceed ``length``
+        (bucket-padded prompts): the padded rows are written but masked
+        by ``length`` until real decode steps overwrite them.
+        """
+        s = kv.shape[2]
+        if s > self.max_seq:
+            raise ValueError(
+                f"prompt length {s} exceeds cache max_seq {self.max_seq}")
+        if not 0 < length <= s:
+            raise ValueError(f"length {length} not in (0, {s}]")
+        self.data = self.data.at[slot, :, :, :s].set(
+            kv.astype(self.data.dtype))
+        self.lengths[slot] = length
+
+    def advance(self, slot: int) -> None:
+        """Record one decoded token in ``slot`` (the device-side write
+        happened inside ``decode_step``)."""
+        self.lengths[slot] += 1

@@ -1,0 +1,55 @@
+"""Token sampling for the decode loop: greedy, temperature, top-k.
+
+``temperature == 0`` means greedy (argmax) — the deterministic mode the
+engine's batched-vs-isolated parity guarantee is stated for.  Stochastic
+modes draw from an explicit PRNG key per call; the engine folds a
+per-request key per step so batch composition never changes a request's
+stream.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Per-request sampling configuration.
+
+    ``temperature``: 0.0 → greedy; otherwise logits are divided by it.
+    ``top_k``: restrict sampling to the k highest-probability tokens
+    (None → full vocab).  Ignored under greedy.
+    """
+    temperature: float = 0.0
+    top_k: Optional[int] = None
+
+    def __post_init__(self):
+        if self.temperature < 0.0:
+            raise ValueError("temperature must be >= 0")
+        if self.top_k is not None and self.top_k <= 0:
+            raise ValueError("top_k must be positive")
+
+    @property
+    def greedy(self) -> bool:
+        return self.temperature == 0.0
+
+
+def sample(logits, params: SamplingParams = SamplingParams(), key=None):
+    """Draw a token id from ``logits`` (``(..., vocab)``).
+
+    Greedy needs no key; stochastic modes require one.  Returns an int
+    array of shape ``logits.shape[:-1]``.
+    """
+    if params.greedy:
+        return jnp.argmax(logits, axis=-1)
+    if key is None:
+        raise ValueError("stochastic sampling requires a PRNG key")
+    scaled = logits.astype(jnp.float32) / params.temperature
+    if params.top_k is not None and params.top_k < logits.shape[-1]:
+        kth = jnp.sort(scaled, axis=-1)[..., -params.top_k][..., None]
+        scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    return jax.random.categorical(key, scaled, axis=-1)

@@ -25,9 +25,12 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.normalization import MixedFusedLayerNorm
-from apex_tpu.ops.flash_attention import flash_attention
-from apex_tpu.ops.rope import fused_apply_rotary_pos_emb_cached, rope_freqs
+from apex_tpu.ops.flash_attention import (flash_attention,
+                                          flash_attention_decode)
+from apex_tpu.ops.rope import (fused_apply_rotary_pos_emb_at_positions,
+                               fused_apply_rotary_pos_emb_cached, rope_freqs)
 from apex_tpu.transformer import tensor_parallel as tp
+from apex_tpu.utils.collectives import axis_size as _axis_size
 
 _f32 = jnp.float32
 
@@ -144,15 +147,23 @@ class ParallelAttention:
         return {"qkv": self.qkv.init_params(k1),
                 "proj": self.proj.init_params(k2)}
 
+    def _qkv(self, params, x):
+        """Project ``x`` and split into ``(q, k, v)``, each
+        ``(b, s, local_heads, head_dim)``."""
+        b = x.shape[0]
+        qkv, _ = self.qkv(params["qkv"], x)      # (b, s, 3h/t)
+        s = qkv.shape[1]
+        nh = qkv.shape[-1] // (3 * self.cfg.head_dim)
+        qkv = qkv.reshape(b, s, nh, 3 * self.cfg.head_dim)
+        return jnp.split(qkv, 3, axis=-1)
+
     def __call__(self, params, x, rope_cos=None, rope_sin=None,
                  dropout_seed=None):
         cfg = self.cfg
         b = x.shape[0]
-        qkv, _ = self.qkv(params["qkv"], x)      # (b, s, 3h/t)
-        s = qkv.shape[1]
-        nh = qkv.shape[-1] // (3 * cfg.head_dim)
-        qkv = qkv.reshape(b, s, nh, 3 * cfg.head_dim)
-        q, k, v = jnp.split(qkv, 3, axis=-1)     # (b, s, nh, hd)
+        q, k, v = self._qkv(params, x)           # (b, s, nh, hd)
+        s = q.shape[1]
+        nh = q.shape[2]
         if rope_cos is not None:
             # fused rope expects (seq, batch, heads, dim)
             q = fused_apply_rotary_pos_emb_cached(
@@ -193,6 +204,64 @@ class ParallelAttention:
         ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, nh * cfg.head_dim)
         out, _ = self.proj(params["proj"], ctx)
         return out
+
+    def prefill(self, params, x, rope_cos=None, rope_sin=None):
+        """Full-sequence causal attention that also returns the post-RoPE
+        K/V in cache layout ``(b, s, local_heads, head_dim)`` — exactly
+        what the decode path reads back, so prefill+decode reproduces the
+        full forward token-for-token."""
+        cfg = self.cfg
+        b = x.shape[0]
+        q, k, v = self._qkv(params, x)           # (b, s, nh, hd)
+        s = q.shape[1]
+        nh = q.shape[2]
+        if rope_cos is not None:
+            q = fused_apply_rotary_pos_emb_cached(
+                q.transpose(1, 0, 2, 3), rope_cos, rope_sin
+            ).transpose(1, 0, 2, 3)
+            k = fused_apply_rotary_pos_emb_cached(
+                k.transpose(1, 0, 2, 3), rope_cos, rope_sin
+            ).transpose(1, 0, 2, 3)
+        ctx = flash_attention(q.transpose(0, 2, 1, 3),
+                              k.transpose(0, 2, 1, 3),
+                              v.transpose(0, 2, 1, 3), causal=True)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, nh * cfg.head_dim)
+        out, _ = self.proj(params["proj"], ctx)
+        return out, (k, v)
+
+    def decode(self, params, x, cache, layer_index, positions):
+        """One-token decode step against the KV cache.
+
+        ``x``: ``(b, 1, hidden)`` — the incoming token's hidden state per
+        cache slot; ``cache``: the full ring
+        ``(slots, layers, 2, max_seq, local_heads, head_dim)``;
+        ``positions``: ``(b,)`` absolute position of the incoming token
+        (== valid cache entries before this step).  Writes the new K/V at
+        ``positions`` (cast to the cache dtype), then attends over
+        ``positions + 1`` entries.  Returns ``(out (b, 1, hidden), cache)``.
+        """
+        cfg = self.cfg
+        b = x.shape[0]
+        q, k, v = self._qkv(params, x)           # (b, 1, nh, hd)
+        q, k, v = q[:, 0], k[:, 0], v[:, 0]      # (b, nh, hd)
+        if cfg.rotary:
+            # full-cache-depth tables; constant-folded under jit
+            f = rope_freqs(cache.shape[3], cfg.head_dim)
+            q = fused_apply_rotary_pos_emb_at_positions(
+                q, jnp.cos(f), jnp.sin(f), positions)
+            k = fused_apply_rotary_pos_emb_at_positions(
+                k, jnp.cos(f), jnp.sin(f), positions)
+        rows = jnp.arange(b)
+        cache = cache.at[rows, layer_index, 0, positions].set(
+            k.astype(cache.dtype))
+        cache = cache.at[rows, layer_index, 1, positions].set(
+            v.astype(cache.dtype))
+        ctx = flash_attention_decode(q, cache[:, layer_index, 0],
+                                     cache[:, layer_index, 1],
+                                     positions + 1)
+        out, _ = self.proj(params["proj"],
+                           ctx.reshape(b, 1, q.shape[1] * cfg.head_dim))
+        return out, cache
 
 
 class ParallelMLP:
@@ -289,6 +358,35 @@ class ParallelTransformerLayer:
                 return x + y, aux
             return x + self.mlp(params["mlp"], h)
 
+    def prefill(self, params, x, rope_cos=None, rope_sin=None):
+        """Inference forward returning ``(x_out, (k, v))`` with this
+        layer's post-RoPE cache entries (MoE aux is discarded —
+        load-balancing loss is a training concern)."""
+        h = self.input_layernorm(params["input_layernorm"], x)
+        attn, kv = self.attention.prefill(params["attention"], h,
+                                          rope_cos, rope_sin)
+        x = x + attn
+        h = self.post_attention_layernorm(
+            params["post_attention_layernorm"], x)
+        y = self.mlp(params["mlp"], h)
+        if self.is_moe:
+            y, _ = y
+        return x + y, kv
+
+    def decode(self, params, x, cache, layer_index, positions):
+        """One-token decode through this layer; see
+        :meth:`ParallelAttention.decode` for the cache contract."""
+        h = self.input_layernorm(params["input_layernorm"], x)
+        attn, cache = self.attention.decode(params["attention"], h,
+                                            cache, layer_index, positions)
+        x = x + attn
+        h = self.post_attention_layernorm(
+            params["post_attention_layernorm"], x)
+        y = self.mlp(params["mlp"], h)
+        if self.is_moe:
+            y, _ = y
+        return x + y, cache
+
 
 class GPTModel:
     """Full decoder LM: vocab-parallel embedding → N layers → final LN →
@@ -343,7 +441,7 @@ class GPTModel:
         local = seq_len or x.shape[1]
         if self.cfg.context_axis is not None:
             # rope positions are GLOBAL: build full tables, take the shard
-            n_ctx = jax.lax.axis_size(self.cfg.context_axis)
+            n_ctx = _axis_size(self.cfg.context_axis)
             cos, sin = self.rope_tables(local * n_ctx)
             if cos is not None:
                 off = self._seq_offset(local)
@@ -427,6 +525,71 @@ class GPTModel:
         return self.logits(params, x)
 
     apply = __call__
+
+    # -- KV-cache inference --------------------------------------------------
+
+    def _check_decode_supported(self):
+        if self.cfg.context_axis is not None:
+            raise ValueError(
+                "KV-cache decode does not compose with context "
+                "parallelism (the cache would be sequence-sharded)")
+        if self.cfg.sequence_parallel:
+            raise ValueError(
+                "KV-cache decode requires sequence_parallel=False "
+                "(decode steps are single-token)")
+
+    def prefill(self, params, tokens):
+        """Process a full prompt; returns ``(logits, kv)``.
+
+        ``logits``: ``(b, s, vocab)`` (vocab-parallel under TP, like
+        :meth:`logits`); ``kv``: ``(layers, 2, b, s, local_heads,
+        head_dim)`` post-RoPE cache entries in the compute dtype — write
+        them into a :class:`~apex_tpu.inference.KVCache` slot (which casts
+        to the cache dtype) and continue with :meth:`decode_step`.
+        Prompts padded beyond their true length are safe: causal masking
+        keeps logits at positions ``< prompt_len`` unaffected, and the
+        padded cache rows are masked by the per-slot length at decode.
+        """
+        self._check_decode_supported()
+        x = self.embed(params, tokens)
+        cos, sin = self.rope_tables(tokens.shape[1])
+        ks, vs = [], []
+        for layer, lp in zip(self.layers, params["layers"]):
+            x, (k, v) = layer.prefill(lp, x, cos, sin)
+            ks.append(k)
+            vs.append(v)
+        kv = jnp.stack([jnp.stack(ks), jnp.stack(vs)], axis=1)
+        return self.logits(params, x), kv
+
+    def decode_step(self, params, tokens, cache, positions):
+        """One batched autoregressive step over the cache ring.
+
+        ``tokens``: ``(slots,)`` int — the token to feed per cache slot;
+        ``cache``: ``(slots, layers, 2, max_seq, local_heads, head_dim)``
+        (any float dtype; bf16 caches accumulate attention in f32);
+        ``positions``: ``(slots,)`` int — each token's absolute position,
+        i.e. the number of valid cache entries before this step.
+
+        Returns ``(logits, cache)`` with ``logits`` ``(slots, vocab)``
+        (vocab-parallel under TP) and the cache advanced by one entry per
+        row.  Rows are mathematically independent, so inactive slots may
+        carry garbage: their writes land at their (stale) position and are
+        overwritten by the next prefill before any valid length reaches
+        them.
+        """
+        self._check_decode_supported()
+        x = self.embedding(params["embedding"], tokens[:, None])
+        if not self.cfg.rotary:
+            x = x + params["position_embedding"][positions][:, None]
+        x = x.astype(self.cfg.dtype)
+        for li, (layer, lp) in enumerate(zip(self.layers,
+                                             params["layers"])):
+            x, cache = layer.decode(lp, x, cache, li, positions)
+        x = self.final_layernorm(params["final_layernorm"], x)
+        w = params["embedding"]["weight"]
+        logits = jnp.einsum("bh,vh->bv", x[:, 0].astype(_f32),
+                            w.astype(_f32))
+        return logits, cache
 
     def loss(self, params, tokens, targets, dropout_seed=None):
         """Mean next-token loss via vocab-parallel cross entropy (+ the
@@ -760,6 +923,8 @@ def pipeline_loss(model: GPTModel, params, tokens, targets, *,
         axes.add(model.cfg.expert_axis)
 
     def _vary(p):
+        if not hasattr(jax, "typeof"):  # pre-vma JAX: implicitly varying
+            return p
         missing = tuple(axes - set(jax.typeof(p).vma))
         return jax.lax.pcast(p, missing, to="varying") if missing else p
 

@@ -40,12 +40,14 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from apex_tpu.multi_tensor_apply.bucketing import _round_up
-from apex_tpu.utils.platform import interpret_mode, use_pallas
+from apex_tpu.utils.platform import (interpret_mode, tpu_compiler_params,
+                                     use_pallas)
 
 _f32 = jnp.float32
 _MASK = -1e30  # finite "minus infinity": exp(_MASK - m) == 0, no NaNs
 
-__all__ = ["flash_attention", "flash_attention_reference"]
+__all__ = ["flash_attention", "flash_attention_reference",
+           "flash_attention_decode", "flash_attention_decode_reference"]
 
 
 # ---------------------------------------------------------------------------
@@ -371,8 +373,7 @@ def _specs(block_q, block_k, d_pad, which):
 
 
 def _compiler_params():
-    return pltpu.CompilerParams(
-        dimension_semantics=("parallel", "parallel", "arbitrary"))
+    return tpu_compiler_params(("parallel", "parallel", "arbitrary"))
 
 
 def _flash_fwd_impl(q, k, v, kv_lens, seed, causal, scale, rate,
@@ -559,6 +560,151 @@ def flash_attention_reference(q, k, v, causal=False, softmax_scale=None,
         keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout, p.shape)
         p = jnp.where(keep, p / (1.0 - dropout), 0.0)
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+
+
+# ---------------------------------------------------------------------------
+# single-query decode path (KV-cache inference)
+# ---------------------------------------------------------------------------
+#
+# Autoregressive decode attends ONE query token per sequence against the
+# accumulated KV cache — there is no O(s^2) score matrix and no backward
+# pass, but the full-sequence kernel would still pad the query extent to a
+# whole q block and mask (block_q - 1) dead rows.  The decode kernel keeps
+# the same online-softmax accumulation with a 1-row query tile, a grid of
+# (batch, heads, k_blocks), and a dynamic per-row length bound from the
+# cache occupancy, reading K/V directly in the cache layout
+# ``(batch, max_seq, heads, head_dim)`` so no transpose of the cache ever
+# materializes.  Blocks entirely past the row's length are skipped at
+# runtime (the decode-side analogue of the causal block skip).  A
+# production kernel would additionally tile multiple heads per program to
+# fill the MXU sublanes; this one optimizes for sharing the flash
+# forward's structure and numerics (f32 accumulation over a bf16 cache).
+
+
+def _decode_kernel(scale, block_k, len_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_scr, l_scr, acc_scr):
+    b = pl.program_id(0)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr[:], _MASK)
+        l_scr[:] = jnp.zeros_like(l_scr[:])
+        acc_scr[:] = jnp.zeros_like(acc_scr[:])
+
+    @pl.when(ki * block_k < len_ref[b])
+    def _compute():
+        q = q_ref[0]                              # (1, d_pad)
+        k = k_ref[0, :, 0, :]                     # (block_k, d_pad)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=_f32) * scale
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)
+        valid = k_pos < len_ref[b]
+        s = jnp.where(valid, s, _MASK)
+        m_prev = m_scr[:, :1]
+        m_cur = jnp.maximum(jnp.max(s, axis=1, keepdims=True), m_prev)
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.where(valid, jnp.exp(s - m_cur), 0.0)
+        l_cur = alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0, :, 0, :],
+            (((1,), (0,)), ((), ())), preferred_element_type=_f32)
+        acc_scr[:] = acc_scr[:] * alpha + pv
+        m_scr[:] = jnp.broadcast_to(m_cur, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_cur, l_scr.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+
+
+def flash_attention_decode_reference(q, k_cache, v_cache, cache_lens,
+                                     softmax_scale=None):
+    """Materialized single-query reference over the cache layout — the
+    off-TPU decode path and the parity baseline for the Pallas kernel.
+
+    ``q``: ``(batch, heads, head_dim)`` (one token per sequence);
+    ``k_cache``/``v_cache``: ``(batch, max_seq, heads, head_dim)``;
+    ``cache_lens``: ``(batch,)`` valid lengths (the query's own position
+    is ``cache_lens - 1``).  Scores and the PV reduction run in f32
+    regardless of the cache dtype (bf16 cache, f32 accumulation).
+    """
+    b, S, h, d = k_cache.shape
+    scale = softmax_scale if softmax_scale is not None else d ** -0.5
+    s = jnp.einsum("bhd,bshd->bhs", q.astype(_f32),
+                   k_cache.astype(_f32)) * scale
+    valid = (jnp.arange(S)[None, :]
+             < cache_lens[:, None])[:, None, :]    # (b, 1, S)
+    s = jnp.where(valid, s, _MASK)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(valid, p, 0.0)
+    o = jnp.einsum("bhs,bshd->bhd", p, v_cache.astype(_f32))
+    return o.astype(q.dtype)
+
+
+def flash_attention_decode(q, k_cache, v_cache, cache_lens,
+                           softmax_scale=None, block_k=512):
+    """Single-token decode attention against a KV cache.
+
+    ``q``: ``(batch, heads, head_dim)`` — the current token's query;
+    ``k_cache``/``v_cache``: ``(batch, max_seq, heads, head_dim)`` — the
+    preallocated cache INCLUDING the current token's K/V (write before
+    attending); ``cache_lens``: ``(batch,)`` int, number of valid cache
+    entries per row.  Entries at positions >= ``cache_lens`` are masked;
+    causality is implied (every cached position <= the query's).
+
+    Returns ``(batch, heads, head_dim)`` in ``q.dtype``; accumulation is
+    f32 whatever the cache dtype.  On TPU a Pallas single-query kernel
+    reads the cache layout directly; off-TPU the masked jnp reference
+    runs (identical semantics, unit-tested against each other).
+    """
+    b, h, d = q.shape
+    S = k_cache.shape[1]
+    scale = float(softmax_scale if softmax_scale is not None
+                  else d ** -0.5)
+    cache_lens = cache_lens.astype(jnp.int32)
+    if not use_pallas():
+        return flash_attention_decode_reference(q, k_cache, v_cache,
+                                                cache_lens, scale)
+    S_pad = _round_up(S, 128)
+    for cand in (int(block_k), 512, 384, 256, 128):
+        if cand <= int(block_k) and S_pad % cand == 0:
+            block_k = cand
+            break
+    else:
+        block_k = min(int(block_k), S_pad)
+    d_pad = _round_up(d, 128)
+    qp = q if d == d_pad else jnp.pad(q, ((0, 0), (0, 0), (0, d_pad - d)))
+    def _pad_cache(c):
+        if S == S_pad and d == d_pad:
+            return c
+        return jnp.pad(c, ((0, 0), (0, S_pad - S), (0, 0),
+                           (0, d_pad - d)))
+    kp, vp = _pad_cache(k_cache), _pad_cache(v_cache)
+    kernel = functools.partial(_decode_kernel, scale, block_k)
+    qo_spec = pl.BlockSpec((1, 1, d_pad), lambda bi, hi, ki: (bi, hi, 0),
+                           memory_space=pltpu.VMEM)
+    kv_spec = pl.BlockSpec((1, block_k, 1, d_pad),
+                           lambda bi, hi, ki: (bi, ki, hi, 0),
+                           memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b, h, S_pad // block_k),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  qo_spec, kv_spec, kv_spec],
+        out_specs=qo_spec,
+        out_shape=_sds((b, h, d_pad), q.dtype, q),
+        scratch_shapes=[pltpu.VMEM((1, 128), _f32),
+                        pltpu.VMEM((1, 128), _f32),
+                        pltpu.VMEM((1, d_pad), _f32)],
+        compiler_params=_compiler_params(),
+        interpret=interpret_mode(),
+    )(cache_lens, qp, kp, vp)
+    return out[:, :, :d]
 
 
 def flash_attention(q, k, v, causal=False, softmax_scale=None,

@@ -36,7 +36,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 from apex_tpu.multi_tensor_apply.bucketing import _round_up
 from apex_tpu.utils.collectives import sds_like as _sds
-from apex_tpu.utils.platform import interpret_mode, use_pallas
+from apex_tpu.utils.platform import (interpret_mode, tpu_compiler_params,
+                                     use_pallas)
 
 _f32 = jnp.float32
 _MASK = -1e30
@@ -50,16 +51,19 @@ __all__ = ["fused_linear_cross_entropy",
 # ---------------------------------------------------------------------------
 
 def _dot_dtype(x_dtype, w_dtype):
-    """Operand dtype for the logit dots: if either side is bf16 the
-    GEMM runs at bf16 (accumulation stays f32 via
-    ``preferred_element_type``) — under O2 the tied embedding IS bf16,
-    and upcasting operands to f32 costs MXU rate for accumulation
-    precision the f32 path already provides.  (Only bf16 is special:
-    Mosaic has no f16 vector type, so f16 operands never reach these
-    kernels.)"""
-    for dt in (x_dtype, w_dtype):
-        if jnp.dtype(dt) == jnp.bfloat16:
-            return jnp.bfloat16
+    """Operand dtype for the logit dots: the bf16 fast path is taken only
+    when BOTH operands are bf16 (accumulation stays f32 via
+    ``preferred_element_type``) — under O2 the whole tied head IS bf16,
+    and upcasting matched-bf16 operands to f32 costs MXU rate for
+    accumulation precision the f32 path already provides.  A MIXED
+    f32/bf16 pair upcasts to f32: downcasting the f32 side would silently
+    drop operand precision in the loss and both gradient GEMMs for any
+    caller passing f32 hidden states with a bf16 tied embedding (ADVICE
+    round 5).  (Only bf16 is special: Mosaic has no f16 vector type, so
+    f16 operands never reach these kernels.)"""
+    if (jnp.dtype(x_dtype) == jnp.bfloat16
+            and jnp.dtype(w_dtype) == jnp.bfloat16):
+        return jnp.bfloat16
     return _f32
 
 
@@ -194,8 +198,7 @@ def _pad2(x, rows, cols):
 
 
 def _compiler_params():
-    return pltpu.CompilerParams(
-        dimension_semantics=("parallel", "arbitrary"))
+    return tpu_compiler_params(("parallel", "arbitrary"))
 
 
 def _fwd_impl(x, w, targets, block_t, block_v):

@@ -93,6 +93,25 @@ def fused_apply_rotary_pos_emb_thd(t, cu_seqlens, freqs):
     return _rope_sbhd(t, jnp.cos(f), jnp.sin(f))
 
 
+def fused_apply_rotary_pos_emb_at_positions(t, cos_cached, sin_cached,
+                                            positions):
+    """Apply RoPE at explicit per-row positions — the decode-step form.
+
+    ``t``: ``(batch, head, dim)`` (one token per sequence);
+    ``cos_cached``/``sin_cached``: ``(max_seq, 1, 1, rot_dim)`` tables from
+    :func:`rope_freqs`'s cos/sin; ``positions``: ``(batch,)`` int absolute
+    positions.  During continuous batching every row sits at a different
+    position, so the table is gathered per row instead of sliced by a
+    shared offset.
+    """
+    rot_dim = cos_cached.shape[-1]
+    cos = cos_cached.astype(_f32).reshape(-1, rot_dim)[positions]
+    sin = sin_cached.astype(_f32).reshape(-1, rot_dim)[positions]
+    cos = cos[:, None, :]                       # (batch, 1, rot_dim)
+    sin = sin[:, None, :]
+    return _apply(t, cos, sin)
+
+
 def rope_freqs(seq_len, rot_dim, base=10000.0, dtype=_f32):
     """Standard RoPE frequency table ``(seq, 1, 1, rot_dim)``."""
     inv = 1.0 / (base ** (jnp.arange(0, rot_dim, 2, dtype=_f32) / rot_dim))

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from apex_tpu.utils.collectives import psum_if_varying
+from apex_tpu.utils.collectives import axis_size as _axis_size
 
 DEFAULT_DATA_AXIS = "data"
 
@@ -62,7 +63,7 @@ def allreduce_gradients(grads, axis_name: str = DEFAULT_DATA_AXIS,
     # again would multiply by axis size.  Reduce only the varying leaves.
     reduced = psum_if_varying(grads, axis_name, strict=strict)
     if average:
-        n = jax.lax.axis_size(axis_name)
+        n = _axis_size(axis_name)
         reduced = jax.tree_util.tree_map(lambda g: g / n, reduced)
     return reduced
 
@@ -171,7 +172,7 @@ class DistributedDataParallel:
             grads = jax.tree_util.tree_map(lambda g: g / factor, grads)
             out = psum_if_varying(grads, self.axis_name)
             if self.gradient_average:
-                n = jax.lax.axis_size(self.axis_name)
+                n = _axis_size(self.axis_name)
                 out = jax.tree_util.tree_map(lambda g: g * (factor / n), out)
             return out
         return allreduce_gradients(grads, self.axis_name,

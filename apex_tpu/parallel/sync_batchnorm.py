@@ -20,6 +20,7 @@ from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+from apex_tpu.utils.collectives import axis_size as _axis_size
 
 _f32 = jnp.float32
 
@@ -65,7 +66,7 @@ def sync_batch_norm(x, weight, bias, state: BatchNormState, *,
         local_sqsum = jnp.sum(xf * xf, axis=red_axes)
         total = _axis_reduce(jnp.stack([local_sum, local_sqsum]), axis_name)
         if axis_name is not None:
-            count = count * jax.lax.axis_size(axis_name)
+            count = count * _axis_size(axis_name)
         mean = total[0] / count
         var = total[1] / count - mean * mean          # biased (normalization)
         unbiased = var * (count / max(count - 1.0, 1.0))

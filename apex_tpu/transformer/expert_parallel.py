@@ -38,6 +38,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from apex_tpu.utils.collectives import axis_size as _axis_size
 
 __all__ = ["MoEConfig", "MoEMLP", "is_gpt_expert_leaf",
            "localize_expert_params", "reduce_moe_grads",
@@ -253,6 +254,8 @@ def vary_params_over_axis(params, axis_name: str):
     model-axis grad reduction and would double-reduce.
     """
     def v(p):
+        if not hasattr(jax, "typeof"):  # pre-vma JAX: implicitly varying
+            return p
         if axis_name in jax.typeof(p).vma:
             return p
         return jax.lax.pcast(p, (axis_name,), to="varying")
@@ -270,7 +273,7 @@ def reduce_moe_grads(grads, axis_name: str,
     transpose already routed to the owning device — divide by the axis
     size and regain the unit mesh axis for ``out_specs``.
     """
-    ep = jax.lax.axis_size(axis_name)
+    ep = _axis_size(axis_name)
     return jax.tree_util.tree_map_with_path(
         lambda p, g: (g / ep)[None] if is_expert(p)
         else jax.lax.pmean(g, axis_name), grads)

@@ -21,10 +21,11 @@ import jax.numpy as jnp
 from apex_tpu.transformer.parallel_state import PIPELINE_AXIS
 from apex_tpu.transformer.pipeline_parallel.spmd import _ring_perm
 from apex_tpu.utils.collectives import ensure_varying
+from apex_tpu.utils.collectives import axis_size as _axis_size
 
 
 def _shift(x, axis_name, forward: bool, wrap: bool):
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     perm = _ring_perm(n) if forward else [(d, s) for s, d in _ring_perm(n)]
     x = ensure_varying(x, axis_name)
     out = jax.lax.ppermute(x, axis_name, perm)

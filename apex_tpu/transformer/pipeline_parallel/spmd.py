@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.transformer.parallel_state import PIPELINE_AXIS
+from apex_tpu.utils.collectives import axis_size as _axis_size
 
 
 def _ring_perm(n):
@@ -64,7 +65,7 @@ def spmd_pipeline(stage_fn: Callable, params, microbatches, *,
       caller masks — apex likewise only has losses on the last rank).
     """
     tmap = jax.tree_util.tree_map
-    S = jax.lax.axis_size(axis_name)
+    S = _axis_size(axis_name)
     s = jax.lax.axis_index(axis_name)
     mb_leaves = jax.tree_util.tree_leaves(microbatches)
     M = mb_leaves[0].shape[0]
@@ -96,10 +97,14 @@ def spmd_pipeline(stage_fn: Callable, params, microbatches, *,
     # param-cotangent carries vary over those axes, and pcast's transpose
     # is a psum over the added axes, which is exactly the cross-device
     # grad accumulation those params need.
-    act_vma = set().union(*(jax.typeof(l).vma for l in mb_leaves)) \
-        | {axis_name}
+    act_vma = None
+    if hasattr(jax, "typeof"):  # pre-vma JAX: everything implicitly varying
+        act_vma = set().union(*(jax.typeof(l).vma for l in mb_leaves)) \
+            | {axis_name}
 
     def _vary(p):
+        if act_vma is None:
+            return p
         missing = tuple(act_vma - set(jax.typeof(p).vma))
         return jax.lax.pcast(p, missing, to="varying") if missing else p
 
@@ -142,7 +147,7 @@ def last_stage_mean_loss(loss_fn, outs, targets, axis_name):
     """Mean microbatch loss, masked so only the final pipeline stage
     contributes, psum-replicated across stages (apex: loss lives on the
     last rank only)."""
-    S = jax.lax.axis_size(axis_name)
+    S = _axis_size(axis_name)
     s = jax.lax.axis_index(axis_name)
     per = jax.vmap(loss_fn)(outs, targets)
     local = jnp.mean(per)

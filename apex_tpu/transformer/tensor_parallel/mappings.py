@@ -29,6 +29,7 @@ from apex_tpu.transformer.parallel_state import TENSOR_AXIS
 
 
 from apex_tpu.utils.collectives import ensure_varying as _vary
+from apex_tpu.utils.collectives import axis_size as _axis_size
 
 
 def _reduce(x, axis):
@@ -36,7 +37,7 @@ def _reduce(x, axis):
 
 
 def _split_along_dim(x, dim, axis):
-    n = jax.lax.axis_size(axis)
+    n = _axis_size(axis)
     r = jax.lax.axis_index(axis)
     size = x.shape[dim] // n
     return jax.lax.dynamic_slice_in_dim(x, r * size, size, axis=dim)

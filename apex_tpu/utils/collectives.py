@@ -11,13 +11,26 @@ def ensure_varying(x, axis_name):
     JAX 0.9 collectives require varying (vma-tracked) inputs inside
     ``shard_map``; ``pcast`` raises when the value is already varying, so
     this is the safe form for values of unknown provenance.  Pytree-aware.
+    On JAX versions without ``pcast`` (pre-vma) every value is implicitly
+    varying and the cast is a no-op.
     """
     def cast(v):
         try:
             return jax.lax.pcast(v, axis_name, to="varying")
         except ValueError:
             return v
+        except AttributeError:
+            return v
     return jax.tree_util.tree_map(cast, x)
+
+
+def axis_size(axis_name):
+    """``jax.lax.axis_size`` with a pre-0.6 fallback (``psum`` of the
+    constant 1 is folded to the axis size without a real collective)."""
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return jax.lax.psum(1, axis_name)
 
 
 def manual_axes() -> frozenset:

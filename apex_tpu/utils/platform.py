@@ -49,3 +49,14 @@ def use_pallas() -> bool:
 def interpret_mode() -> bool:
     """Pallas ``interpret=`` flag: interpret when not actually on TPU."""
     return not is_tpu_backend()
+
+
+def tpu_compiler_params(dimension_semantics: tuple):
+    """``pltpu.CompilerParams`` across JAX versions (older releases call
+    the same dataclass ``TPUCompilerParams``) — single compat point for
+    every Pallas op's ``compiler_params=``."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams")
+    return cls(dimension_semantics=dimension_semantics)

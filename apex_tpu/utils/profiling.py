@@ -15,6 +15,9 @@ Nsight.  The TPU equivalents wired here:
   multi-controller race-safety replacement: XLA programs are data-race
   free, so the remaining divergence risk is hosts compiling DIFFERENT
   programs; hash the optimized HLO and compare.
+* :class:`ServingMetrics` — inference-serving observability (TTFT,
+  per-token latency, slot occupancy, tokens/s) for
+  ``apex_tpu.inference``'s continuous-batching engine.
 """
 
 from __future__ import annotations
@@ -22,6 +25,7 @@ from __future__ import annotations
 import contextlib
 import hashlib
 import threading
+import time
 from typing import Any, Callable
 
 import jax
@@ -128,3 +132,71 @@ def assert_same_program(fn_or_hash: Any, *args, **jit_kwargs) -> str:
                     f"has {h}, host {rank} differs — the controllers built "
                     "different programs")
     return h
+
+
+class ServingMetrics:
+    """Host-side serving observability for the continuous-batching engine.
+
+    Tracks, per request, time-to-first-token (submit → first sampled
+    token, i.e. including queueing + prefill) and per-token decode
+    latencies; plus per-step slot occupancy samples for the engine as a
+    whole.  ``clock`` is injectable (tests pass a fake counter) and
+    defaults to ``time.monotonic``.  All aggregation is lazy —
+    :meth:`summary` computes percentiles over whatever has been recorded.
+    """
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        self.clock = clock
+        self._submitted: dict = {}       # request_id -> submit time
+        self._last_token: dict = {}      # request_id -> last token time
+        self.ttft: dict = {}             # request_id -> seconds
+        self.token_latencies: list = []  # seconds, across all requests
+        self.occupancy: list = []        # (active, total) per engine step
+        self.tokens_emitted = 0
+        self._started: float | None = None
+
+    def request_submitted(self, request_id) -> None:
+        self._submitted[request_id] = self.clock()
+        if self._started is None:
+            self._started = self._submitted[request_id]
+
+    def first_token(self, request_id) -> None:
+        now = self.clock()
+        self.ttft[request_id] = now - self._submitted.get(request_id, now)
+        self._last_token[request_id] = now
+        self.tokens_emitted += 1
+
+    def token(self, request_id) -> None:
+        now = self.clock()
+        prev = self._last_token.get(request_id)
+        if prev is not None:
+            self.token_latencies.append(now - prev)
+        self._last_token[request_id] = now
+        self.tokens_emitted += 1
+
+    def step(self, active_slots: int, total_slots: int) -> None:
+        self.occupancy.append((active_slots, total_slots))
+
+    @staticmethod
+    def _pct(xs, q):
+        if not xs:
+            return 0.0
+        xs = sorted(xs)
+        i = min(len(xs) - 1, int(round(q * (len(xs) - 1))))
+        return xs[i]
+
+    def summary(self) -> dict:
+        elapsed = (self.clock() - self._started) if self._started else 0.0
+        occ = ([a / t for a, t in self.occupancy if t]
+               if self.occupancy else [])
+        return {
+            "requests": len(self.ttft),
+            "tokens": self.tokens_emitted,
+            "tokens_per_s": (self.tokens_emitted / elapsed
+                             if elapsed > 0 else 0.0),
+            "ttft_p50_s": self._pct(list(self.ttft.values()), 0.5),
+            "ttft_max_s": max(self.ttft.values()) if self.ttft else 0.0,
+            "token_latency_p50_s": self._pct(self.token_latencies, 0.5),
+            "token_latency_p90_s": self._pct(self.token_latencies, 0.9),
+            "slot_occupancy_mean": (sum(occ) / len(occ)) if occ else 0.0,
+        }

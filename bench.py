@@ -235,7 +235,14 @@ def _accumulated_grads(loss_fn, params, tokens, labels, accum,
     by tools/sweep_gpt.py) so the accumulation numerics cannot drift
     between them."""
     if accum == 1:
-        return jax.value_and_grad(loss_fn)(params, tokens[0], labels[0])
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens[0],
+                                                  labels[0])
+        # same cotangent dtype contract as the accumulated branch: the
+        # optimizer must see identical grad dtypes whatever accum is
+        if grad_dtype is not None:
+            grads = jax.tree_util.tree_map(
+                lambda g: g.astype(grad_dtype), grads)
+        return loss, grads
 
     def mb(carry, tl):
         tk, lb = tl
@@ -440,6 +447,57 @@ def bench_gpt_train_step():
     return {"n_params": n_params, "batch": batch, "accum": accum,
             "seq": seq, "remat": "none", "optimizer_layout": "per_leaf",
             **out}
+
+
+def bench_gpt_decode():
+    """Serving leg: prefill latency + steady-state batched decode
+    throughput on the GPT-350M config with a bf16 KV cache.
+
+    Decode is measured over the FULL slot table at mid-sequence depth —
+    the continuous-batching engine's steady state, where every step is
+    one `decode_step` whose batch dimension is the slot ring.  BASELINE
+    has no inference row, so this rides in `extra` (the serving targets
+    live in README "Inference & serving")."""
+    from apex_tpu.models.gpt import GPTConfig, GPTModel
+    from apex_tpu.utils.platform import is_tpu_backend
+
+    cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
+                    num_attention_heads=16, max_seq_len=1024,
+                    dtype=jnp.bfloat16)
+    slots, prompt_len = 8, 512
+    model = GPTModel(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+
+    prefill = jax.jit(model.prefill)
+    prompt = jnp.asarray(rng.randint(0, cfg.vocab_size, (1, prompt_len)))
+    t_prefill = _time_steps(lambda t: prefill(params, t)[0], (prompt,),
+                            warmup=2, iters=4, rounds=3)
+
+    cache = jnp.zeros((slots, cfg.num_layers, 2, cfg.max_seq_len,
+                       cfg.num_attention_heads, cfg.head_dim),
+                      jnp.bfloat16)
+    positions = jnp.full((slots,), prompt_len, jnp.int32)
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (slots,)))
+    # the cache threads step-to-step; donate it on TPU so XLA writes in
+    # place (donating on CPU only emits warnings)
+    step = jax.jit(model.decode_step,
+                   donate_argnums=(2,) if is_tpu_backend() else ())
+    holder = {"c": cache}
+
+    def run(tokens, positions):
+        logits, holder["c"] = step(params, tokens, holder["c"], positions)
+        return logits
+
+    dt = _time_steps(run, (tokens, positions), warmup=2, iters=16,
+                     rounds=3)
+    return {"slots": slots, "prompt_len": prompt_len,
+            "max_seq": cfg.max_seq_len, "cache_dtype": "bfloat16",
+            "prefill_s": t_prefill,
+            "prefill_tokens_per_s": prompt_len / t_prefill,
+            "decode_step_s": dt,
+            "decode_tokens_per_s": slots / dt,
+            "decode_token_latency_ms": dt * 1e3}
 
 
 # ---------------------------------------------------------------------------
@@ -681,6 +739,7 @@ def main():
     if bert is None:
         raise RuntimeError("headline BERT leg failed after retries")
     gpt = _retry(bench_gpt_train_step)
+    decode = _retry(bench_gpt_decode)
     breakdown = _retry(bench_bert_breakdown)
     in_step = _retry(bench_lamb_in_step)
     adam = _retry(bench_fused_adam_vs_optax)
@@ -703,6 +762,7 @@ def main():
             "gpt_350m_train_mfu": None if gpt is None else round(
                 gpt["mfu"], 4),
             "gpt": rounded(gpt),
+            "gpt_decode": rounded(decode),
             "fused_adam_vs_optax": rounded(adam),
         },
     }

@@ -28,7 +28,7 @@ import sys
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 PACKAGES = ["apex_tpu.amp", "apex_tpu.optimizers", "apex_tpu.transformer",
-            "apex_tpu.parallel"]
+            "apex_tpu.parallel", "apex_tpu.inference"]
 
 _PAGE = """<!doctype html>
 <html><head><meta charset="utf-8"><title>{title}</title>

@@ -5,7 +5,7 @@
   must exit 0;
 * always: the dependency-free ``docs/build.py`` renderer must produce
   the page set (user pages + live-introspection API pages for
-  amp/optimizers/transformer/parallel).
+  amp/optimizers/transformer/parallel/inference).
 """
 
 import pathlib
@@ -26,11 +26,14 @@ def test_fallback_builder(tmp_path):
     pages = {p.name for p in out.glob("*.html")}
     assert "index.html" in pages
     for pkg in ["apex_tpu_amp", "apex_tpu_optimizers",
-                "apex_tpu_transformer", "apex_tpu_parallel"]:
+                "apex_tpu_transformer", "apex_tpu_parallel",
+                "apex_tpu_inference"]:
         assert f"{pkg}.html" in pages, pages
     # API pages carry real introspected content, not empty shells
     amp = (out / "apex_tpu_amp.html").read_text()
     assert "initialize" in amp and "scale_loss" in amp
+    inf = (out / "apex_tpu_inference.html").read_text()
+    assert "InferenceEngine" in inf and "KVCache" in inf
 
 
 def test_sphinx_build(tmp_path):

@@ -95,6 +95,17 @@ class TestExamples:
              "--opt-level", opt_level]))
         assert "devices=8" in out
 
+    def test_serving_engine(self):
+        """The inference subsystem end-to-end: continuous batching over
+        2 cache slots with a mixed greedy/top-k workload."""
+        out = _check(_run_example(
+            "examples/serving/generate_gpt.py",
+            ["--requests", "4", "--max-slots", "2", "--hidden", "32",
+             "--layers", "1", "--heads", "2", "--vocab", "64",
+             "--max-seq", "32", "--max-new-tokens", "6",
+             "--temperature", "0.7"]))
+        assert "served 4 requests" in out
+
     def test_gpt7b_recipe_smoke(self):
         """BASELINE row 2's runnable artifact: the 7B TP x PP recipe at
         --smoke keeps the full tp=2 x pp=2 x dp=2 topology and every
