@@ -134,6 +134,8 @@ class InferenceEngine:
     def _finish(self, slot: int, st: _Active, reason: str) -> None:
         self.cache.free(slot)
         del self._active[slot]
+        if reason == "evicted":
+            self.metrics.request_evicted(st.request.request_id)
         self._done.append(Response(st.request.request_id,
                                    list(st.request.prompt),
                                    st.generated, reason))
@@ -163,6 +165,7 @@ class InferenceEngine:
         while self._queue:
             req = self._queue.popleft()
             if expired(req):
+                self.metrics.request_evicted(req.request_id)
                 self._done.append(Response(req.request_id,
                                            list(req.prompt), [],
                                            "evicted"))

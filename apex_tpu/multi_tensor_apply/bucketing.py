@@ -121,6 +121,39 @@ def row_tensor_ids(meta: BucketMeta) -> np.ndarray:
     return ids
 
 
+def split_by_message_size(shapes: Sequence[tuple], dtype,
+                          message_size: int) -> list[list[int]]:
+    """Partition tensor indices into contiguous groups of ≤ ``message_size``
+    BYTES each (apex bucket semantics: ``DistributedDataParallel``'s
+    ``message_size`` caps the flattened allreduce payload in bytes, so the
+    element budget is dtype-aware — a 10 MB cap holds 2.5M f32 elements
+    but 5M bf16).  Sizing uses each tensor's LANE-padded footprint
+    (``padded_elements * itemsize``), the bytes the packed buffer actually
+    ships.  A single tensor larger than the cap gets its own group rather
+    than being split — a bucket is the *unit* of collective dispatch and
+    tensors are never torn across buckets (matching apex, where one
+    oversized param simply becomes its own flush).
+    """
+    if message_size <= 0:
+        raise ValueError(f"message_size must be positive, got {message_size}")
+    itemsize = jnp.dtype(dtype).itemsize
+    sizes = tuple(int(np.prod(s, dtype=np.int64)) if len(s) else 1
+                  for s in shapes)
+    groups: list[list[int]] = []
+    cur: list[int] = []
+    cur_bytes = 0
+    for i, size in enumerate(sizes):
+        nbytes = _round_up(max(size, 1), LANE) * itemsize
+        if cur and cur_bytes + nbytes > message_size:
+            groups.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += nbytes
+    if cur:
+        groups.append(cur)
+    return groups
+
+
 def group_by_dtype(tensors: Sequence[jax.Array]):
     """Group tensor indices by dtype (order-preserving).
 

@@ -72,6 +72,7 @@ class FusedOptimizer:
                  master_weights: bool = False,
                  block_rows: int = B.DEFAULT_BLOCK_ROWS,
                  bucketed: bool = True,
+                 message_size: Optional[int] = None,
                  **defaults):
         self.defaults = dict(lr=lr, weight_decay=weight_decay, **defaults)
         self.param_group_fn = param_group_fn
@@ -79,6 +80,11 @@ class FusedOptimizer:
         self.master_weights = bool(master_weights)
         self.block_rows = int(block_rows)
         self.bucketed = bool(bucketed)
+        # apex semantics: cap each packed bucket at ``message_size`` BYTES
+        # (dtype-aware — the cap bounds the flattened collective payload,
+        # so a bf16 bucket holds twice the elements of an f32 one).
+        # None = one bucket per (group, dtype), the prior behavior.
+        self.message_size = None if message_size is None else int(message_size)
         self._layout_cache: dict = {}
 
     # -- layout ------------------------------------------------------------
@@ -106,9 +112,19 @@ class FusedOptimizer:
         buckets = []
         for (name, dtype), idxs in groups.items():
             shapes = tuple(tuple(leaves[i].shape) for i in idxs)
-            meta = B.bucket_meta(shapes, dtype, self._meta_block_rows())
-            buckets.append(BucketInfo(f"{name}/{dtype}", name,
-                                      tuple(idxs), meta))
+            if self.message_size is None:
+                parts = [list(range(len(idxs)))]
+            else:
+                parts = B.split_by_message_size(shapes, dtype,
+                                                self.message_size)
+            for j, part in enumerate(parts):
+                sub_idxs = tuple(idxs[k] for k in part)
+                sub_shapes = tuple(shapes[k] for k in part)
+                meta = B.bucket_meta(sub_shapes, dtype,
+                                     self._meta_block_rows())
+                key = (f"{name}/{dtype}" if len(parts) == 1
+                       else f"{name}/{dtype}/{j}")
+                buckets.append(BucketInfo(key, name, sub_idxs, meta))
         layout = Layout(tuple(buckets), len(leaves))
         self._layout_cache[cache_key] = layout
         return layout

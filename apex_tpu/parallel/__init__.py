@@ -6,6 +6,10 @@ from apex_tpu.parallel.distributed import (
     allreduce_gradients,
     DEFAULT_DATA_AXIS,
 )
+from apex_tpu.parallel.distributed_optimizer import (
+    DistributedFusedAdam,
+    DistributedFusedLAMB,
+)
 from apex_tpu.parallel.sync_batchnorm import (
     SyncBatchNorm,
     BatchNormState,
@@ -19,6 +23,8 @@ __all__ = [
     "Reducer",
     "allreduce_gradients",
     "DEFAULT_DATA_AXIS",
+    "DistributedFusedAdam",
+    "DistributedFusedLAMB",
     "SyncBatchNorm",
     "BatchNormState",
     "sync_batch_norm",

@@ -15,9 +15,13 @@ scheduler overlaps them with compute.  What remains for the API is:
 * the manual-trigger variant — :class:`Reducer`;
 * ``delay_allreduce`` semantics → gradient-accumulation boundary control.
 
-Knobs that only make sense for NCCL stream management (``message_size``,
-``num_allreduce_streams``, ``allreduce_communicators``) are accepted and
-ignored so apex recipes run unchanged.
+Knobs that only make sense for NCCL stream management
+(``num_allreduce_streams``, ``allreduce_communicators``) are accepted and
+ignored so apex recipes run unchanged.  ``message_size`` keeps apex's
+meaning — a per-bucket BYTE cap — and is honored where buckets become
+explicit collectives: the fused/distributed optimizers
+(``FusedOptimizer(message_size=...)``,
+:mod:`apex_tpu.parallel.distributed_optimizer`).
 """
 
 from __future__ import annotations
@@ -28,6 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from apex_tpu.utils import compressed_allreduce as _CA
 from apex_tpu.utils.collectives import psum_if_varying
 from apex_tpu.utils.collectives import axis_size as _axis_size
 
@@ -35,10 +40,14 @@ DEFAULT_DATA_AXIS = "data"
 
 
 def _has_axis(axis_name) -> bool:
+    # Unbound axis names have raised a different exception in nearly
+    # every JAX generation: classic NameError, KeyError from the
+    # axis-env lookup, ValueError ("unbound axis name"), and TypeError
+    # when the frame stack is empty.  Treat them all as "no such axis".
     try:
         jax.lax.axis_index(axis_name)
         return True
-    except NameError:
+    except (NameError, KeyError, ValueError, TypeError):
         return False
 
 
@@ -100,10 +109,17 @@ class DistributedDataParallel:
                  allreduce_communicators=None,
                  gradient_average: bool = True,
                  gradient_predivide_factor: float = 1.0,
+                 allreduce_dtype=None,
                  prof: bool = False):
-        del (message_size, shared_param, allreduce_trigger_params,
+        del (shared_param, allreduce_trigger_params,
              retain_allreduce_buffers, num_allreduce_streams,
              allreduce_communicators, prof)  # NCCL-only knobs
+        # message_size is apex's per-bucket BYTE cap.  DDP's own reduce is
+        # one fused psum (XLA chunks it), so the cap matters only where
+        # buckets become explicit collectives: kept here so recipes can
+        # forward it to the distributed optimizers, which honor it
+        # (FusedOptimizer(message_size=...), dtype-aware bytes).
+        self.message_size = int(message_size)
         self.module = module
         self.mesh = mesh
         self.axis_name = axis_name
@@ -111,6 +127,12 @@ class DistributedDataParallel:
         self.allreduce_always_fp32 = bool(allreduce_always_fp32)
         self.gradient_average = bool(gradient_average)
         self.gradient_predivide_factor = float(gradient_predivide_factor)
+        self.allreduce_dtype = _CA.check_mode(allreduce_dtype)
+        if self.allreduce_dtype is not None and mesh is None:
+            raise ValueError(
+                "allreduce_dtype={!r} needs the compressed collectives' "
+                "static world size — pass mesh= so it can be read from "
+                "mesh.shape[axis_name]".format(allreduce_dtype))
 
     # -- GSPMD path --------------------------------------------------------
 
@@ -155,12 +177,27 @@ class DistributedDataParallel:
         Skip both calls and grads come out already summed (not averaged) —
         the compiler-managed path.
         """
+        if not hasattr(jax.lax, "pcast"):
+            # pre-vma JAX: every shard_map value is implicitly varying —
+            # grads already come out local, nothing to mark
+            return params
         return jax.tree_util.tree_map(
             lambda x: jax.lax.pcast(x, self.axis_name, to="varying"), params)
 
+    def _psum_grads(self, grads):
+        # one fused psum, or the compressed all-reduce when
+        # allreduce_dtype asks for bf16/int8 transport
+        if self.allreduce_dtype is None:
+            return psum_if_varying(grads, self.axis_name)
+        world = int(self.mesh.shape[self.axis_name])
+        return _CA.psum_tree_compressed(grads, self.axis_name, world,
+                                        self.allreduce_dtype)
+
     def reduce(self, grads):
         """The bucketed allreduce, as one collective (use inside
-        ``shard_map``)."""
+        ``shard_map``).  Transport follows the constructor's
+        ``allreduce_dtype`` (None/'f32' exact, 'bf16'/'int8' compressed —
+        see :mod:`apex_tpu.utils.compressed_allreduce`)."""
         if self.allreduce_always_fp32:
             grads = jax.tree_util.tree_map(
                 lambda g: g.astype(jnp.float32), grads)
@@ -170,13 +207,16 @@ class DistributedDataParallel:
             # unconditionally (fp16 overflow safety), then by `world/factor`
             # after only when averaging — net sum/factor otherwise.
             grads = jax.tree_util.tree_map(lambda g: g / factor, grads)
-            out = psum_if_varying(grads, self.axis_name)
+            out = self._psum_grads(grads)
             if self.gradient_average:
                 n = _axis_size(self.axis_name)
                 out = jax.tree_util.tree_map(lambda g: g * (factor / n), out)
             return out
-        return allreduce_gradients(grads, self.axis_name,
-                                   average=self.gradient_average)
+        out = self._psum_grads(grads)
+        if self.gradient_average:
+            n = _axis_size(self.axis_name)
+            out = jax.tree_util.tree_map(lambda g: g / n, out)
+        return out
 
     @staticmethod
     def accumulate(acc, grads, main_grad_dtype=None):

@@ -9,8 +9,14 @@ from apex_tpu.utils.pytree import (
     tree_cast,
     tree_zeros_like,
 )
+from apex_tpu.utils.compressed_allreduce import (
+    psum_compressed,
+    psum_tree_compressed,
+)
 
 __all__ = [
+    "psum_compressed",
+    "psum_tree_compressed",
     "is_tpu_backend",
     "use_pallas",
     "set_force_pallas",

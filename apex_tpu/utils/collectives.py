@@ -24,6 +24,28 @@ def ensure_varying(x, axis_name):
     return jax.tree_util.tree_map(cast, x)
 
 
+def shard_map_compat(fn, *, mesh, in_specs, out_specs, check: bool = False):
+    """``shard_map`` across the supported JAX version span.
+
+    JAX 0.6+ exposes ``jax.shard_map`` whose consistency knob is
+    ``check_vma``; 0.4.x keeps it under ``jax.experimental.shard_map``
+    with the older ``check_rep`` spelling.  ``check=False`` (the default
+    here) is what every explicit-collective region in this package needs:
+    gathered-but-replicated values fail both checkers' static inference.
+    """
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        try:
+            return sm(fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_vma=check)
+        except TypeError:  # jax.shard_map generations with check_rep
+            return sm(fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check)
+    from jax.experimental.shard_map import shard_map as esm
+    return esm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check)
+
+
 def axis_size(axis_name):
     """``jax.lax.axis_size`` with a pre-0.6 fallback (``psum`` of the
     constant 1 is folded to the axis size without a real collective)."""

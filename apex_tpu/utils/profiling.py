@@ -153,6 +153,7 @@ class ServingMetrics:
         self.token_latencies: list = []  # seconds, across all requests
         self.occupancy: list = []        # (active, total) per engine step
         self.tokens_emitted = 0
+        self.evicted = 0                 # deadline evictions (active+queued)
         self._started: float | None = None
 
     def request_submitted(self, request_id) -> None:
@@ -177,6 +178,12 @@ class ServingMetrics:
     def step(self, active_slots: int, total_slots: int) -> None:
         self.occupancy.append((active_slots, total_slots))
 
+    def request_evicted(self, request_id) -> None:
+        """A request hit its deadline — mid-decode or still queued.
+        Without this the slot simply vanished from the stats (a request
+        that never reached first_token left no trace in ``summary``)."""
+        self.evicted += 1
+
     @staticmethod
     def _pct(xs, q):
         if not xs:
@@ -192,6 +199,7 @@ class ServingMetrics:
         return {
             "requests": len(self.ttft),
             "tokens": self.tokens_emitted,
+            "evicted": self.evicted,
             "tokens_per_s": (self.tokens_emitted / elapsed
                              if elapsed > 0 else 0.0),
             "ttft_p50_s": self._pct(list(self.ttft.values()), 0.5),

@@ -731,6 +731,86 @@ def bench_fused_adam_vs_optax():
     }
 
 
+def bench_dp_comm():
+    """Data-parallel comms leg (PR 2): the same Adam update at dp>=2 as
+    (a) replicated — psum all grads, every device runs the full per-leaf
+    update (the pre-PR-2 DP path); (b) sharded-update —
+    DistributedFusedAdam's reduce-scatter / 1-of-dp shard update /
+    all-gather (arXiv:2004.13336); (c) sharded + int8 block-quantized
+    grad transport (EQuARX, arXiv:2506.17615).  Reports step time per
+    arm; the acceptance bar is sharded <= replicated at dp>=2 (on a
+    single chip there is no dp to measure, so the leg degrades to a
+    skip marker)."""
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.parallel import DistributedFusedAdam
+    from apex_tpu.utils.collectives import shard_map_compat
+
+    dp = len(jax.devices())
+    if dp < 2:
+        return {"skipped": f"needs dp>=2, have {dp} device(s)"}
+    _free_calibration()
+    mesh = jax.make_mesh((dp,), ("data",))
+    rng = np.random.RandomState(2)
+    shapes = []
+    for _ in range(4):
+        shapes += [(512, 512), (2048, 512), (512, 2048), (512,), (2048,)]
+    shapes += [(8192, 512)]
+    params = {f"p{i}": jnp.asarray(rng.randn(*s).astype(np.float32) * 0.02)
+              for i, s in enumerate(shapes)}
+    # stacked per-device microbatch grads, sharded over the data axis —
+    # the same input every arm consumes (its reduction is what differs)
+    grads = {k: jnp.asarray(rng.randn(dp, *v.shape).astype(np.float32)
+                            * 1e-3) for k, v in params.items()}
+    g_specs = jax.tree_util.tree_map(lambda _: P("data"), params)
+
+    leaf = FusedAdam(lr=1e-3, bucketed=False)
+    lstate = leaf.init(params)
+
+    @jax.jit
+    def replicated_step(g, p, s):
+        def local(g, p, s):
+            g = jax.tree_util.tree_map(lambda x: x[0], g)
+            g = jax.tree_util.tree_map(
+                lambda x: jax.lax.psum(x, "data") / dp, g)
+            return leaf.step(g, p, s)
+        return shard_map_compat(local, mesh=mesh,
+                                in_specs=(g_specs, P(), P()),
+                                out_specs=(P(), P()))(g, p, s)
+
+    arms = {}
+
+    def rep_arm():
+        return _time_steps(replicated_step, (grads, params, lstate),
+                           warmup=2, iters=4, rounds=3)
+    arms["replicated"] = _retry(rep_arm)
+
+    for name, mode in (("sharded", None), ("sharded_int8", "int8")):
+        opt = DistributedFusedAdam(lr=1e-3, world_size=dp,
+                                   allreduce_dtype=mode)
+        state = opt.make_init(mesh)(params)
+        step = opt.make_step(mesh)
+
+        def dist_arm():
+            return _time_steps(step, (grads, params, state),
+                               warmup=2, iters=4, rounds=3)
+        arms[name] = _retry(dist_arm)
+        jax.clear_caches()
+
+    out = {"dp": dp,
+           "n_elements": int(sum(int(np.prod(s)) for s in shapes)),
+           "step_time_s": {k: (round(v, 6) if v else None)
+                           for k, v in arms.items()}}
+    if arms["replicated"] and arms["sharded"]:
+        out["sharded_vs_replicated_speedup"] = round(
+            arms["replicated"] / arms["sharded"], 3)
+    if arms["replicated"] and arms["sharded_int8"]:
+        out["int8_vs_replicated_speedup"] = round(
+            arms["replicated"] / arms["sharded_int8"], 3)
+    return out
+
+
 def main():
     backend = jax.default_backend()
     # headline leg is hard-required (retried, then raises); auxiliary
@@ -743,6 +823,7 @@ def main():
     breakdown = _retry(bench_bert_breakdown)
     in_step = _retry(bench_lamb_in_step)
     adam = _retry(bench_fused_adam_vs_optax)
+    dp_comm = _retry(bench_dp_comm)
     rounded = lambda d: (None if d is None else
                          {k: (round(v, 6) if isinstance(v, float) else v)
                           for k, v in d.items()})
@@ -764,6 +845,7 @@ def main():
             "gpt": rounded(gpt),
             "gpt_decode": rounded(decode),
             "fused_adam_vs_optax": rounded(adam),
+            "dp_comm": dp_comm,
         },
     }
     print(json.dumps(result))

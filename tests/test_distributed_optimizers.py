@@ -13,6 +13,7 @@ from apex_tpu.contrib.optimizers import (
     DistributedFusedLAMB,
 )
 from apex_tpu.optimizers import FusedAdam, FusedLAMB
+from apex_tpu.utils.collectives import shard_map_compat
 
 N = 8
 
@@ -42,17 +43,17 @@ def _run_dist(opt, mesh, params, stacked_grads, n_steps=3):
     specs = opt.state_specs(params)
     g_specs = jax.tree_util.tree_map(lambda _: P("data"), params)
 
-    init = jax.shard_map(opt.init, mesh=mesh, in_specs=(P(),),
-                               out_specs=specs, check_vma=False)
+    init = shard_map_compat(opt.init, mesh=mesh, in_specs=(P(),),
+                            out_specs=specs)
     state = init(params)
 
     def local_step(g, p, s):
         g = jax.tree_util.tree_map(lambda x: x[0], g)  # drop device axis
         return opt.step(g, p, s)
 
-    step = jax.jit(jax.shard_map(
+    step = jax.jit(shard_map_compat(
         local_step, mesh=mesh, in_specs=(g_specs, P(), specs),
-        out_specs=(P(), specs), check_vma=False))
+        out_specs=(P(), specs)))
     for _ in range(n_steps):
         params, state = step(stacked_grads, params, state)
     return params, state
@@ -81,9 +82,8 @@ class TestDistributedFusedAdam:
         """ZeRO accounting: each device holds 1/N of every moment bucket."""
         params = _params(rng)
         opt = DistributedFusedAdam(lr=1e-2, world_size=N, block_rows=8)
-        init = jax.shard_map(opt.init, mesh=mesh, in_specs=(P(),),
-                                   out_specs=opt.state_specs(params),
-                                   check_vma=False)
+        init = shard_map_compat(opt.init, mesh=mesh, in_specs=(P(),),
+                                out_specs=opt.state_specs(params))
         state = init(params)
         for key, bucket in state["buckets"].items():
             for name, arr in bucket.items():
@@ -128,17 +128,17 @@ class TestDistributedFusedAdam:
         opt = DistributedFusedAdam(lr=1e-2, world_size=N, block_rows=8)
         specs = opt.state_specs(params)
         g_specs = jax.tree_util.tree_map(lambda _: P("data"), params)
-        init = jax.shard_map(opt.init, mesh=mesh, in_specs=(P(),),
-                                   out_specs=specs, check_vma=False)
+        init = shard_map_compat(opt.init, mesh=mesh, in_specs=(P(),),
+                                out_specs=specs)
         state = init(params)
 
         def local_step(g, p, s):
             g = jax.tree_util.tree_map(lambda x: x[0], g)
             return opt.step(g, p, s, noop_flag=jnp.ones(()))
 
-        step = jax.shard_map(
+        step = shard_map_compat(
             local_step, mesh=mesh, in_specs=(g_specs, P(), specs),
-            out_specs=(P(), specs), check_vma=False)
+            out_specs=(P(), specs))
         new_params, new_state = step(stacked, params, state)
         for k in params:
             np.testing.assert_array_equal(np.asarray(new_params[k]),
@@ -261,6 +261,93 @@ class TestMakeStep:
             step(stacked, params, state)
 
 
+class TestAllreduceDtype:
+    """The quantized-transport knob (compressed_allreduce): f32 is
+    bitwise-identical to the default path; bf16/int8 track it within the
+    documented tolerance of the grad reduce-scatter."""
+
+    def test_f32_mode_bitwise_exact(self, rng, mesh):
+        params = _params(rng)
+        stacked, _ = _per_device_grads(rng, params)
+        base = DistributedFusedAdam(lr=1e-2, world_size=N, block_rows=8)
+        f32 = DistributedFusedAdam(lr=1e-2, world_size=N, block_rows=8,
+                                   allreduce_dtype="f32")
+        p_base, _ = _run_dist(base, mesh, params, stacked, n_steps=2)
+        p_f32, _ = _run_dist(f32, mesh, params, stacked, n_steps=2)
+        for k in params:
+            np.testing.assert_array_equal(np.asarray(p_base[k]),
+                                          np.asarray(p_f32[k]))
+
+    @pytest.mark.parametrize("mode", ["bf16", "int8"])
+    def test_quantized_tracks_exact(self, rng, mesh, mode):
+        """Adam normalizes per element, so a quantization-induced sign
+        flip on a near-zero-grad element costs up to a full ±lr step —
+        the worst-case divergence bound is ``2 * lr * n_steps`` (the
+        documented tolerance), while typical elements barely move."""
+        lr, n_steps = 1e-2, 2
+        params = _params(rng)
+        stacked, mean = _per_device_grads(rng, params)
+        opt = DistributedFusedAdam(lr=lr, world_size=N, block_rows=8,
+                                   allreduce_dtype=mode)
+        dist_params, _ = _run_dist(opt, mesh, params, stacked,
+                                   n_steps=n_steps)
+        ref_opt = FusedAdam(lr=lr, block_rows=8)
+        ref_state = ref_opt.init(params)
+        ref_params = params
+        for _ in range(n_steps):
+            ref_params, ref_state = ref_opt.step(mean, ref_params,
+                                                 ref_state)
+        bound = 2 * lr * n_steps
+        for k in params:
+            diff = np.abs(np.asarray(dist_params[k])
+                          - np.asarray(ref_params[k]))
+            assert diff.max() <= bound * 1.01, (k, diff.max())
+            # the sign-flip worst case is rare: the bulk of the update
+            # must agree to ~transport precision
+            assert np.mean(diff) < bound / 20, (k, np.mean(diff))
+
+    def test_lamb_int8_via_make_step(self, rng, mesh):
+        params = _params(rng)
+        stacked, mean = _per_device_grads(rng, params)
+        opt = DistributedFusedLAMB(lr=1e-2, world_size=N, block_rows=8,
+                                   allreduce_dtype="int8")
+        state = opt.make_init(mesh)(params)
+        new_params, state = opt.make_step(mesh)(stacked, params, state)
+        ref_opt = FusedLAMB(lr=1e-2, block_rows=8)
+        ref_params, _ = ref_opt.step(mean, params, ref_opt.init(params))
+        for k in params:
+            np.testing.assert_allclose(new_params[k], ref_params[k],
+                                       rtol=2e-2, atol=2e-2)
+
+    def test_bad_mode_raises(self):
+        with pytest.raises(ValueError, match="allreduce_dtype"):
+            DistributedFusedAdam(lr=1e-2, world_size=N,
+                                 allreduce_dtype="fp8")
+
+
+class TestMessageSize:
+    """apex bucket semantics: ``message_size`` caps each packed bucket in
+    BYTES (dtype-aware), splitting the layout into more buckets without
+    changing the math."""
+
+    def test_split_layout_parity(self, rng, mesh):
+        params = _params(rng)
+        stacked, _ = _per_device_grads(rng, params)
+        one = DistributedFusedAdam(lr=1e-2, world_size=N, block_rows=8)
+        # 16 KiB cap forces each ~LANE-padded f32 tensor into its own
+        # bucket (w2 alone is 129*40*4 ≈ 20 KiB padded)
+        split = DistributedFusedAdam(lr=1e-2, world_size=N, block_rows=8,
+                                     message_size=16 * 1024)
+        assert len(split._layout(params).buckets) > \
+            len(one._layout(params).buckets)
+        p_one, _ = _run_dist(one, mesh, params, stacked, n_steps=2)
+        p_split, _ = _run_dist(split, mesh, params, stacked, n_steps=2)
+        for k in params:
+            np.testing.assert_allclose(np.asarray(p_one[k]),
+                                       np.asarray(p_split[k]),
+                                       rtol=1e-6, atol=1e-6)
+
+
 class TestDistributedMasterParams:
     def test_master_params_gathers_shards(self, rng, mesh):
         """master_params on ZeRO state must all-gather the row-sharded
@@ -277,9 +364,9 @@ class TestDistributedMasterParams:
                                       n_steps=1)
 
         specs = opt.state_specs(params)
-        masters = jax.jit(jax.shard_map(
+        masters = jax.jit(shard_map_compat(
             opt.master_params, mesh=mesh, in_specs=(P(), specs),
-            out_specs=P(), check_vma=False))(new_params, state)
+            out_specs=P()))(new_params, state)
         for k in params:
             assert masters[k].dtype == jnp.float32
             # model params are the bf16 round-trip of the masters

@@ -285,6 +285,8 @@ class TestEngine:
             eng.submit(_clone(r))
         batched = {r.request_id: r.tokens for r in eng.run()}
         assert len(batched) == len(reqs)
+        # no deadlines in this workload: the eviction counter must stay 0
+        assert eng.metrics.summary()["evicted"] == 0
         for r in reqs:
             solo = InferenceEngine(model, params, max_slots=1,
                                    cache_dtype=jnp.float32)
@@ -331,12 +333,15 @@ class TestEngine:
         assert out[1].finish_reason == "length"
         assert out[0].finish_reason == "evicted"
         assert 0 < len(out[0].tokens) < 100
+        # the eviction reached the serving stats (not just the Response)
+        assert eng.metrics.summary()["evicted"] == 1
         # queued-but-never-run requests past deadline evict empty
         eng2 = InferenceEngine(model, params, max_slots=1, clock=clock,
                                cache_dtype=jnp.float32)
         eng2.submit(Request(request_id=7, prompt=[1], deadline=t[0] - 1))
         (r,) = eng2.run()
         assert r.finish_reason == "evicted" and r.tokens == []
+        assert eng2.metrics.summary()["evicted"] == 1
 
     def test_eos_and_cache_exhaustion(self, rng):
         model, params = _model_and_params()

@@ -10,11 +10,20 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
 
+from apex_tpu.utils.collectives import shard_map_compat as shard_map
 from apex_tpu.parallel import (DistributedDataParallel, SyncBatchNorm,
                                sync_batch_norm, allreduce_gradients, LARC,
                                Reducer)
+from apex_tpu.parallel.distributed import _has_axis
+
+# vma (varying-axes) tracking — and with it mark_local / invariant-grad
+# detection — only exists on JAX ≥0.6; on older JAX every shard_map value
+# is implicitly varying and jax.grad of replicated inputs auto-psums.
+requires_vma = pytest.mark.skipif(
+    not hasattr(jax, "typeof"),
+    reason="needs vma tracking (jax.typeof); this JAX auto-psums grads "
+           "of replicated shard_map inputs")
 from apex_tpu.parallel.sync_batchnorm import BatchNormState
 from apex_tpu.contrib.clip_grad import clip_grad_norm_
 from apex_tpu.optimizers import FusedSGD
@@ -49,6 +58,7 @@ class TestDDP:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-5, atol=1e-6)
 
+    @requires_vma
     def test_shard_map_reduce_matches_serial(self, rng, mesh):
         """Explicit-collective path: per-device grads + ddp.reduce =
         full-batch grads."""
@@ -75,6 +85,7 @@ class TestDDP:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-5, atol=1e-6)
 
+    @requires_vma
     def test_reduce_of_invariant_grads_no_double_count(self, rng, mesh):
         """Grads computed WITHOUT mark_local come out device-invariant
         (jax.grad already psummed them); reduce() must not multiply them by
@@ -134,6 +145,73 @@ class TestDDP:
                              in_specs=(P("data"),), out_specs=P())(g)
 
         np.testing.assert_allclose(np.asarray(run(g)), 1.0, rtol=1e-6)
+
+    def test_predivide_factor_sum_mode(self, rng, mesh):
+        """gradient_predivide_factor with gradient_average=False: apex's
+        staging nets out to sum/factor (pre-divide runs unconditionally,
+        the post-scale only fires when averaging)."""
+        g = jnp.ones((8, 4, 128), jnp.float32)
+
+        @jax.jit
+        def run(g):
+            ddp = DistributedDataParallel(mesh=mesh,
+                                          gradient_predivide_factor=4.0,
+                                          gradient_average=False)
+            return shard_map(lambda g: ddp.reduce(g[0]), mesh=mesh,
+                             in_specs=(P("data"),), out_specs=P())(g)
+
+        # sum(1/4 each of 8 devices) = 2.0, no post-scale
+        np.testing.assert_allclose(np.asarray(run(g)), 2.0, rtol=1e-6)
+
+    def test_predivide_factor_fp32_sum_mode(self, rng, mesh):
+        """Both post-scale-skipping knobs together: bf16 grads upcast by
+        allreduce_always_fp32, predivided, summed — never rescaled."""
+        g = jnp.full((8, 4, 128), 0.5, jnp.bfloat16)
+
+        @jax.jit
+        def run(g):
+            ddp = DistributedDataParallel(mesh=mesh,
+                                          gradient_predivide_factor=2.0,
+                                          gradient_average=False,
+                                          allreduce_always_fp32=True)
+            return shard_map(lambda g: ddp.reduce(g[0]), mesh=mesh,
+                             in_specs=(P("data"),), out_specs=P())(g)
+
+        out = run(g)
+        assert out.dtype == jnp.float32
+        np.testing.assert_allclose(np.asarray(out), 2.0, rtol=1e-6)
+
+    @pytest.mark.parametrize("mode,tol", [("f32", 0.0), ("bf16", 5e-3),
+                                          ("int8", 2e-2)])
+    def test_allreduce_dtype_modes(self, rng, mesh, mode, tol):
+        """allreduce_dtype transport knob on ddp.reduce: f32 bitwise-
+        equal to the default psum, bf16/int8 within documented error."""
+        g = jnp.asarray(rng.randn(8, 16, 128).astype(np.float32))
+        ref = np.mean(np.asarray(g), axis=0)
+
+        @jax.jit
+        def run(g):
+            ddp = DistributedDataParallel(mesh=mesh, allreduce_dtype=mode)
+            return shard_map(lambda g: ddp.reduce(g[0]), mesh=mesh,
+                             in_specs=(P("data"),), out_specs=P())(g)
+
+        out = np.asarray(run(g))
+        if mode == "f32":
+            base = DistributedDataParallel(mesh=mesh)
+
+            @jax.jit
+            def run_base(g):
+                return shard_map(lambda g: base.reduce(g[0]), mesh=mesh,
+                                 in_specs=(P("data"),), out_specs=P())(g)
+
+            np.testing.assert_array_equal(out, np.asarray(run_base(g)))
+        else:
+            err = np.max(np.abs(out - ref)) / np.max(np.abs(ref))
+            assert err < tol, err
+
+    def test_allreduce_dtype_requires_mesh(self):
+        with pytest.raises(ValueError, match="mesh"):
+            DistributedDataParallel(allreduce_dtype="int8")
 
     def test_reducer(self, mesh):
         r = Reducer()
@@ -292,6 +370,36 @@ class TestMainGradAccumulation:
         g = {"w": jnp.ones((4,), jnp.bfloat16)}
         acc = DistributedDataParallel.accumulate(None, g)
         assert acc["w"].dtype == jnp.bfloat16
+
+
+class TestHasAxis:
+    """_has_axis must treat every 'unbound axis name' exception flavor —
+    NameError classically, but newer JAX generations raise KeyError /
+    ValueError / TypeError from the axis-env lookup — as False."""
+
+    def test_unbound_axis_outside_trace(self):
+        assert _has_axis("no_such_axis") is False
+
+    def test_bound_axis_inside_shard_map(self, mesh):
+        seen = []
+
+        def body(x):
+            seen.append((_has_axis("data"), _has_axis("bogus")))
+            return x
+
+        shard_map(body, mesh=mesh, in_specs=(P("data"),),
+                  out_specs=P("data"))(jnp.arange(8.0))
+        assert seen and seen[0] == (True, False)
+
+    def test_bound_axis_under_vmap(self):
+        seen = []
+
+        def body(x):
+            seen.append(_has_axis("batch"))
+            return x
+
+        jax.vmap(body, axis_name="batch")(jnp.arange(4.0))
+        assert seen == [True]
 
 
 class TestContribOptimizerShims:
