@@ -44,6 +44,7 @@ class BertConfig:
     tensor_parallel_size: int = 1
     axis_name: Optional[str] = None
     sequence_parallel: bool = False
+    overlap_chunks: int = 0                    # >0: ppermute-ring TP GEMMs
     remat: bool = False
     remat_policy: str = "full"                 # "full" | "dots" (selective)
     dtype: jnp.dtype = jnp.float32
@@ -62,6 +63,13 @@ class BertConfig:
         if self.num_attention_heads % self.tensor_parallel_size:
             raise ValueError("num_attention_heads must be divisible by "
                              "tensor_parallel_size")
+        if self.overlap_chunks < 0:
+            raise ValueError(
+                f"overlap_chunks must be >= 0, got {self.overlap_chunks}")
+        if self.overlap_chunks > 0 and not self.sequence_parallel:
+            raise ValueError(
+                "overlap_chunks rings the sequence-parallel collective/GEMM "
+                "pairs; it requires sequence_parallel=True")
 
     @property
     def head_dim(self):
@@ -78,11 +86,13 @@ class BertSelfAttention:
             cfg.hidden_size, 3 * cfg.hidden_size, gather_output=False,
             world_size=cfg.tensor_parallel_size, axis_name=cfg.axis_name,
             sequence_parallel_enabled=cfg.sequence_parallel,
+            seq_dim=1, overlap_chunks=cfg.overlap_chunks,
             param_dtype=cfg.param_dtype)
         self.proj = tp.RowParallelLinear(
             cfg.hidden_size, cfg.hidden_size, input_is_parallel=True,
             world_size=cfg.tensor_parallel_size, axis_name=cfg.axis_name,
             sequence_parallel_enabled=cfg.sequence_parallel,
+            seq_dim=1, overlap_chunks=cfg.overlap_chunks,
             param_dtype=cfg.param_dtype)
 
     def init_params(self, key):
@@ -118,11 +128,13 @@ class BertLayer:
             cfg.hidden_size, cfg.ffn_hidden_size, gather_output=False,
             world_size=cfg.tensor_parallel_size, axis_name=cfg.axis_name,
             sequence_parallel_enabled=cfg.sequence_parallel,
+            seq_dim=1, overlap_chunks=cfg.overlap_chunks,
             param_dtype=cfg.param_dtype)
         self.fc2 = tp.RowParallelLinear(
             cfg.ffn_hidden_size, cfg.hidden_size, input_is_parallel=True,
             world_size=cfg.tensor_parallel_size, axis_name=cfg.axis_name,
             sequence_parallel_enabled=cfg.sequence_parallel,
+            seq_dim=1, overlap_chunks=cfg.overlap_chunks,
             param_dtype=cfg.param_dtype)
         self.output_layernorm = MixedFusedLayerNorm(cfg.hidden_size)
 
@@ -135,13 +147,25 @@ class BertLayer:
                 "fc2": self.fc2.init_params(k3),
                 "output_layernorm": self.output_layernorm.init_params()}
 
+    def _sp_ln_params(self, params, name):
+        """Under SP the per-layer LNs run on the sequence shard, so their
+        (replicated) params see per-shard partial grads; identity-fwd/
+        psum-bwd restores the total (Megatron's SP grad allreduce)."""
+        p = params[name]
+        if self.cfg.sequence_parallel and self.cfg.axis_name is not None:
+            p = tp.copy_to_tensor_model_parallel_region(
+                p, self.cfg.axis_name)
+        return p
+
     def __call__(self, params, x, seqlens=None):
         h = self.attention(params["attention"], x, seqlens)
-        x = self.attention_layernorm(params["attention_layernorm"], x + h)
+        x = self.attention_layernorm(
+            self._sp_ln_params(params, "attention_layernorm"), x + h)
         h, _ = self.fc1(params["fc1"], x)
         h = jax.nn.gelu(h, approximate=True)
         h, _ = self.fc2(params["fc2"], h)
-        return self.output_layernorm(params["output_layernorm"], x + h)
+        return self.output_layernorm(
+            self._sp_ln_params(params, "output_layernorm"), x + h)
 
 
 class BertModel:
@@ -195,6 +219,16 @@ class BertModel:
                              token_type_ids, axis=0)
         x = self.embedding_layernorm(params["embedding_layernorm"], x)
         x = x.astype(cfg.dtype)
+        sp = cfg.sequence_parallel and cfg.axis_name is not None
+        if sp:
+            # Megatron SP: the per-layer LNs and residuals run on
+            # (b, s/t, h); each block's TP edges gather/reduce-scatter
+            if tokens.shape[1] % cfg.tensor_parallel_size:
+                raise ValueError(
+                    f"sequence_parallel requires seq_len divisible by "
+                    f"tensor_parallel_size ({tokens.shape[1]} % "
+                    f"{cfg.tensor_parallel_size} != 0)")
+            x = tp.scatter_to_sequence_parallel_region(x, cfg.axis_name, 1)
         for layer, lp in zip(self.layers, params["layers"]):
             if cfg.remat:
                 x = jax.checkpoint(
@@ -203,17 +237,31 @@ class BertModel:
                         lp, x, seqlens)
             else:
                 x = layer(lp, x, seqlens)
+        if sp:
+            x = tp.gather_from_sequence_parallel_region(x, cfg.axis_name, 1)
         return x
 
     __call__ = apply
 
     def _mlm_transform(self, params, hidden):
-        """Transform + GELU + LN before the tied decoder."""
+        """Transform + GELU + LN before the tied decoder.
+
+        Under SP the vocab-parallel CE backward delivers per-vocab-shard
+        partial cotangents here, so the replicated transform/LN params
+        need an identity-fwd/psum-bwd wrap (see BertLayer._sp_ln_params).
+        """
+        mt, ln = params["mlm_transform"], params["mlm_layernorm"]
+        if (self.cfg.sequence_parallel
+                and self.cfg.axis_name is not None):
+            mt = tp.copy_to_tensor_model_parallel_region(
+                mt, self.cfg.axis_name)
+            ln = tp.copy_to_tensor_model_parallel_region(
+                ln, self.cfg.axis_name)
         h = (hidden.astype(_f32)
-             @ params["mlm_transform"]["weight"].astype(_f32)
-             + params["mlm_transform"]["bias"].astype(_f32))
+             @ mt["weight"].astype(_f32)
+             + mt["bias"].astype(_f32))
         h = jax.nn.gelu(h, approximate=True)
-        return self.mlm_layernorm(params["mlm_layernorm"], h)
+        return self.mlm_layernorm(ln, h)
 
     def mlm_logits(self, params, hidden):
         """Tied-decoder vocab(-parallel) logits ``(b, s, vocab/t)``."""

@@ -63,6 +63,7 @@ class GPTConfig:
     tensor_parallel_size: int = 1
     axis_name: Optional[str] = None            # "model" inside shard_map
     sequence_parallel: bool = False
+    overlap_chunks: int = 0                    # >0: ppermute-ring TP GEMMs
     rotary: bool = True
     context_axis: Optional[str] = None         # CP: sequence sharded here
     context_mechanism: str = "ring"            # "ring" | "ulysses"
@@ -115,6 +116,22 @@ class GPTConfig:
             raise ValueError(
                 f"remat_policy must be 'full' or 'dots', got "
                 f"{self.remat_policy!r}")
+        if self.overlap_chunks < 0:
+            raise ValueError(
+                f"overlap_chunks must be >= 0, got {self.overlap_chunks}")
+        if self.overlap_chunks > 0 and not self.sequence_parallel:
+            raise ValueError(
+                "overlap_chunks rings the sequence-parallel collective/GEMM "
+                "pairs; it requires sequence_parallel=True")
+        if self.sequence_parallel and self.context_axis is not None:
+            raise ValueError(
+                "sequence_parallel and context parallelism both shard the "
+                "sequence dimension; enable one or the other")
+        if self.sequence_parallel and self.n_experts > 0:
+            raise ValueError(
+                "sequence_parallel does not compose with MoE FFNs: the "
+                "router's TP-internal psum assumes every tensor rank sees "
+                "the same (replicated) tokens, but SP shards them")
 
     @property
     def head_dim(self):
@@ -135,11 +152,13 @@ class ParallelAttention:
             cfg.hidden_size, 3 * cfg.hidden_size, gather_output=False,
             world_size=cfg.tensor_parallel_size, axis_name=cfg.axis_name,
             sequence_parallel_enabled=cfg.sequence_parallel,
+            seq_dim=1, overlap_chunks=cfg.overlap_chunks,
             param_dtype=cfg.param_dtype)
         self.proj = tp.RowParallelLinear(
             cfg.hidden_size, cfg.hidden_size, input_is_parallel=True,
             world_size=cfg.tensor_parallel_size, axis_name=cfg.axis_name,
             sequence_parallel_enabled=cfg.sequence_parallel,
+            seq_dim=1, overlap_chunks=cfg.overlap_chunks,
             param_dtype=cfg.param_dtype)
 
     def init_params(self, key):
@@ -273,11 +292,13 @@ class ParallelMLP:
             cfg.hidden_size, cfg.ffn_hidden_size, gather_output=False,
             world_size=cfg.tensor_parallel_size, axis_name=cfg.axis_name,
             sequence_parallel_enabled=cfg.sequence_parallel,
+            seq_dim=1, overlap_chunks=cfg.overlap_chunks,
             param_dtype=cfg.param_dtype)
         self.fc2 = tp.RowParallelLinear(
             cfg.ffn_hidden_size, cfg.hidden_size, input_is_parallel=True,
             world_size=cfg.tensor_parallel_size, axis_name=cfg.axis_name,
             sequence_parallel_enabled=cfg.sequence_parallel,
+            seq_dim=1, overlap_chunks=cfg.overlap_chunks,
             param_dtype=cfg.param_dtype)
 
     def init_params(self, key):
@@ -342,17 +363,30 @@ class ParallelTransformerLayer:
                     self.post_attention_layernorm.init_params(),
                 "mlp": self.mlp.init_params(k2)}
 
+    def _sp_ln_params(self, params, name):
+        """LayerNorms run on the SEQ-SHARDED stream under SP, so their
+        per-device grads only cover local tokens; identity-fwd/psum-bwd
+        restores the total (Megatron's allreduce of sequence-parallel-
+        region layernorm grads)."""
+        p = params[name]
+        if self.cfg.sequence_parallel and self.cfg.axis_name is not None:
+            from apex_tpu.transformer.tensor_parallel import mappings as M
+            p = M.copy_to_tensor_model_parallel_region(
+                p, self.cfg.axis_name)
+        return p
+
     def __call__(self, params, x, rope_cos=None, rope_sin=None,
                  dropout_seed=None):
         # named scopes land in HLO metadata -> visible in xprof traces
         # (the reference's nvtx range annotations, SURVEY §5)
         with jax.named_scope("attention"):
-            h = self.input_layernorm(params["input_layernorm"], x)
+            h = self.input_layernorm(
+                self._sp_ln_params(params, "input_layernorm"), x)
             x = x + self.attention(params["attention"], h, rope_cos,
                                    rope_sin, dropout_seed)
         with jax.named_scope("mlp"):
             h = self.post_attention_layernorm(
-                params["post_attention_layernorm"], x)
+                self._sp_ln_params(params, "post_attention_layernorm"), x)
             if self.is_moe:
                 y, aux = self.mlp(params["mlp"], h)
                 return x + y, aux
@@ -483,9 +517,19 @@ class GPTModel:
                 x = out
         return x, aux_total
 
+    def _final_ln_params(self, params):
+        """Under SP the head's cotangents are per-vocab-shard partials, so
+        the (replicated) final-LN params see partial grads; identity-fwd/
+        psum-bwd restores the total (see ParallelTransformerLayer)."""
+        p = params["final_layernorm"]
+        if self._sp_enabled():
+            p = tp.copy_to_tensor_model_parallel_region(
+                p, self.cfg.axis_name)
+        return p
+
     def logits(self, params, x):
         """Tied LM head: vocab-parallel logits ``(b, s, vocab/t)``."""
-        x = self.final_layernorm(params["final_layernorm"], x)
+        x = self.final_layernorm(self._final_ln_params(params), x)
         w = params["embedding"]["weight"]
         return jnp.einsum("bsh,vh->bsv", x.astype(_f32),
                           w.astype(_f32))
@@ -519,9 +563,37 @@ class GPTModel:
             logits.reshape(b * s, vl), targets.reshape(b * s),
             axis_name=self.cfg.axis_name).reshape(b, s)
 
+    def _sp_enabled(self):
+        return (self.cfg.sequence_parallel
+                and self.cfg.axis_name is not None)
+
+    def _sp_scatter(self, x):
+        """Megatron SP entry edge: shard activations along the sequence
+        dim so LayerNorms, residual adds and (in the backward) their
+        grads run on ``(b, s/t, h)``; each block's column gather / row
+        reduce-scatter restores and reshards inside the TP regions."""
+        if x.shape[1] % self.cfg.tensor_parallel_size:
+            raise ValueError(
+                f"sequence_parallel requires seq_len divisible by "
+                f"tensor_parallel_size ({x.shape[1]} % "
+                f"{self.cfg.tensor_parallel_size} != 0)")
+        return tp.scatter_to_sequence_parallel_region(
+            x, self.cfg.axis_name, 1)
+
+    def _sp_gather(self, x):
+        """SP exit edge before the (vocab-parallel) head; the backward is
+        a reduce-scatter summing the per-rank vocab-shard contributions."""
+        return tp.gather_from_sequence_parallel_region(
+            x, self.cfg.axis_name, 1)
+
     def __call__(self, params, tokens, dropout_seed=None):
         x = self.embed(params, tokens)
-        x, _ = self.backbone(params, x, dropout_seed=dropout_seed)
+        if self._sp_enabled():
+            x = self._sp_scatter(x)
+        x, _ = self.backbone(params, x, seq_len=tokens.shape[1],
+                             dropout_seed=dropout_seed)
+        if self._sp_enabled():
+            x = self._sp_gather(x)
         return self.logits(params, x)
 
     apply = __call__
@@ -605,7 +677,12 @@ class GPTModel:
         (None) for eval.
         """
         x = self.embed(params, tokens)
-        x, aux = self.backbone(params, x, dropout_seed=dropout_seed)
+        if self._sp_enabled():
+            x = self._sp_scatter(x)
+        x, aux = self.backbone(params, x, seq_len=tokens.shape[1],
+                               dropout_seed=dropout_seed)
+        if self._sp_enabled():
+            x = self._sp_gather(x)
         mean = jnp.mean(self.head_loss(params, x, targets))
         if self.cfg.n_experts > 0:
             mean = mean + self.cfg.moe_aux_weight * aux / len(self.layers)

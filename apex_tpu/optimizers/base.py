@@ -14,24 +14,26 @@ dicts.  Pytrees have no identity-based grouping, so groups are expressed as
 overrides in ``param_groups={name: {...}}``; ungrouped leaves fall into
 ``"default"``.
 
-Two execution layouts (``bucketed`` ctor flag):
+Two execution layouts (``bucketed`` ctor flag, default ``None`` =
+per-class default):
 
-* ``bucketed=True`` (default, apex parity): state lives in packed
+* ``bucketed=False`` (the single-chip DEFAULT): state lives per leaf and
+  the step is the same single-source ``_*_math`` update applied per leaf
+  as plain jnp, which XLA fuses into the surrounding train step.  On a
+  single chip this is the FASTER path: a pallas_call's operands must be
+  materialized buffers, so the packed path pays a pack (concat) + unpack
+  (slice) HBM round trip per step that per-leaf fusion never performs —
+  measured ~150 ms vs ~40 ms for the BERT-large LAMB census on v5e, i.e.
+  ``packed_vs_optax_speedup = 0.531`` in BENCH_r05 (bench.py
+  ``fused_adam_vs_optax``).  apex has no equivalent switch because CUDA
+  launch overhead forces fusion the other way (see SURVEY §3.2); on TPU
+  the launch-count argument inverts.
+* ``bucketed=True`` (apex parity layout): state lives in packed
   ``(rows, 128)`` buckets and each step is one Pallas kernel sweep per
   bucket.  This is the layout the ZeRO/distributed optimizers REQUIRE —
-  the packed rows are what reduce-scatter/all-gather shard evenly.
-* ``bucketed=False``: state lives per leaf and the step is the same
-  single-source ``_*_math`` update applied per leaf as plain jnp, which
-  XLA fuses into the surrounding train step.  On a single chip this is
-  the FASTER path: a pallas_call's operands must be materialized
-  buffers, so the packed path pays a pack (concat) + unpack (slice)
-  HBM round trip per step that per-leaf fusion never performs —
-  measured ~150 ms vs ~40 ms for the BERT-large LAMB census on v5e
-  (bench.py ``fused_adam_vs_optax`` / BENCH_r05_local.json).  apex has no
-  equivalent switch because CUDA launch overhead forces fusion the
-  other way (see SURVEY §3.2); on TPU the launch-count argument
-  inverts, so the idiomatic default for SINGLE-CHIP model training is
-  per-leaf while the packed engine carries the distributed layouts.
+  the packed rows are what reduce-scatter/all-gather shard evenly — so
+  it stays THEIR default; requesting it explicitly on a plain optimizer
+  warns about the measured single-chip regression.
 """
 
 from __future__ import annotations
@@ -66,12 +68,17 @@ def _leaf_key(path, leaf):
 class FusedOptimizer:
     """Base class: bucket layout, hyperparameter resolution, master weights."""
 
+    # per-leaf is the single-chip default (see module docstring); the
+    # distributed/ZeRO mixin overrides this to True — its sharding IS the
+    # packed layout
+    _default_bucketed = False
+
     def __init__(self, lr, *, weight_decay=0.0,
                  param_group_fn: Optional[Callable[[str], str]] = None,
                  param_groups: Optional[dict] = None,
                  master_weights: bool = False,
                  block_rows: int = B.DEFAULT_BLOCK_ROWS,
-                 bucketed: bool = True,
+                 bucketed: Optional[bool] = None,
                  message_size: Optional[int] = None,
                  **defaults):
         self.defaults = dict(lr=lr, weight_decay=weight_decay, **defaults)
@@ -79,6 +86,19 @@ class FusedOptimizer:
         self.param_groups = dict(param_groups or {})
         self.master_weights = bool(master_weights)
         self.block_rows = int(block_rows)
+        if bucketed is None:
+            bucketed = self._default_bucketed
+        elif bucketed and not self._default_bucketed:
+            import warnings
+            warnings.warn(
+                "bucketed=True (packed multi_tensor layout) measured ~2x "
+                "slower than the per-leaf default for single-chip steps "
+                "(bench.py fused_adam_vs_optax: packed_vs_optax_speedup="
+                "0.531) — the pack/unpack HBM round trip outweighs the "
+                "launch savings on TPU.  Prefer the per-leaf default; the "
+                "packed layout is the distributed (ZeRO) optimizers' "
+                "sharding unit and remains their default.",
+                stacklevel=2)
         self.bucketed = bool(bucketed)
         # apex semantics: cap each packed bucket at ``message_size`` BYTES
         # (dtype-aware — the cap bounds the flattened collective payload,

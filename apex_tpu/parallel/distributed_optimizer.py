@@ -58,6 +58,11 @@ __all__ = ["DistributedFusedAdam", "DistributedFusedLAMB"]
 class _DistributedMixin:
     """Reduce-scatter → local fused update → all-gather over ``axis_name``."""
 
+    # the packed (rows, 128) buckets ARE the ZeRO sharding unit, so the
+    # distributed subclasses keep bucketed as their default even though
+    # the single-chip base default is per-leaf
+    _default_bucketed = True
+
     def _dist_init(self, world_size, axis_name, average_grads,
                    allreduce_dtype=None):
         if world_size < 1:

@@ -15,6 +15,8 @@ from apex_tpu.transformer.tensor_parallel.mappings import (
     scatter_to_sequence_parallel_region,
     gather_from_sequence_parallel_region,
     reduce_scatter_to_sequence_parallel_region,
+    column_parallel_linear_overlap,
+    row_parallel_linear_overlap,
 )
 from apex_tpu.transformer.tensor_parallel.random import (
     checkpoint,
@@ -45,6 +47,8 @@ __all__ = [
     "scatter_to_sequence_parallel_region",
     "gather_from_sequence_parallel_region",
     "reduce_scatter_to_sequence_parallel_region",
+    "column_parallel_linear_overlap",
+    "row_parallel_linear_overlap",
     "checkpoint",
     "get_cuda_rng_tracker",
     "model_parallel_cuda_manual_seed",

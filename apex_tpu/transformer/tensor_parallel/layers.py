@@ -60,7 +60,8 @@ def linear_with_grad_accumulation_and_async_allreduce(
         x, weight, bias=None, gradient_accumulation_fusion: bool = False,
         async_grad_allreduce: bool = True,
         sequence_parallel_enabled: bool = False,
-        axis_name: Optional[str] = TENSOR_AXIS):
+        axis_name: Optional[str] = TENSOR_AXIS,
+        seq_dim: int = 0, overlap_chunks: int = 0):
     """Column-parallel matmul with the apex collective pairing.
 
     ``async_grad_allreduce`` is parity-only: the input-grad allreduce /
@@ -74,11 +75,27 @@ def linear_with_grad_accumulation_and_async_allreduce(
     main_grad_dtype=jnp.float32)`` and the optimizer applies them via its
     fp32 master path (``master_weights=True``).  Same arithmetic as the
     reference: per-microbatch bf16 wgrads summed in fp32.
+
+    ``overlap_chunks > 0`` (requires ``sequence_parallel_enabled``) takes
+    the explicit latency-hiding path instead: the sequence all-gather and
+    the GEMM fuse into a ``ppermute`` ring
+    (:func:`mappings.column_parallel_linear_overlap`) whose custom VJP
+    rings the backward reduce-scatter and accumulates the weight grad
+    chunkwise during the regather — the scheduled form of the overlap the
+    apex signature promises.  Each ring step's GEMM is further split into
+    ``overlap_chunks`` sub-GEMMs along ``seq_dim``.
     """
     del gradient_accumulation_fusion, async_grad_allreduce
     if axis_name is not None:
+        if sequence_parallel_enabled and overlap_chunks > 0:
+            y = M.column_parallel_linear_overlap(
+                x, weight, axis_name, seq_dim, overlap_chunks)
+            if bias is not None:
+                y = y + bias.astype(y.dtype)
+            return y
         if sequence_parallel_enabled:
-            x = M.gather_from_sequence_parallel_region(x, axis_name)
+            x = M.gather_from_sequence_parallel_region(x, axis_name,
+                                                       seq_dim)
         else:
             x = M.copy_to_tensor_model_parallel_region(x, axis_name)
     # compute at the ACTIVATION dtype (Megatron bf16 training keeps fp32
@@ -109,17 +126,25 @@ class ColumnParallelLinear:
                  gradient_accumulation_fusion=False,
                  world_size: Optional[int] = None,
                  axis_name: Optional[str] = TENSOR_AXIS,
+                 seq_dim: int = 0, overlap_chunks: int = 0,
                  param_dtype=_f32):
         if gather_output and sequence_parallel_enabled:
             raise RuntimeError(
                 "`gather_output` and `sequence_parallel_enabled` cannot "
                 "both be True")  # apex parity
+        if overlap_chunks > 0 and not sequence_parallel_enabled:
+            raise RuntimeError(
+                "`overlap_chunks` rings the sequence-parallel "
+                "gather→GEMM pair; it requires "
+                "`sequence_parallel_enabled=True`")
         self.input_size = int(input_size)
         self.output_size = int(output_size)
         self.use_bias = bool(bias)
         self.gather_output = bool(gather_output)
         self.skip_bias_add = bool(skip_bias_add)
         self.sequence_parallel_enabled = bool(sequence_parallel_enabled)
+        self.seq_dim = int(seq_dim)
+        self.overlap_chunks = int(overlap_chunks)
         self.axis_name = axis_name
         self.world_size = int(world_size) if world_size else 1
         self.output_size_per_partition = divide(self.output_size,
@@ -155,7 +180,8 @@ class ColumnParallelLinear:
             x, params["weight"],
             None if self.skip_bias_add else bias,
             sequence_parallel_enabled=self.sequence_parallel_enabled,
-            axis_name=self.axis_name)
+            axis_name=self.axis_name, seq_dim=self.seq_dim,
+            overlap_chunks=self.overlap_chunks)
         if self.gather_output and self.axis_name is not None:
             y = M.gather_from_tensor_model_parallel_region(y, self.axis_name)
         if self.skip_bias_add:
@@ -175,17 +201,25 @@ class RowParallelLinear:
                  gradient_accumulation_fusion=False,
                  world_size: Optional[int] = None,
                  axis_name: Optional[str] = TENSOR_AXIS,
+                 seq_dim: int = 0, overlap_chunks: int = 0,
                  param_dtype=_f32):
         if sequence_parallel_enabled and not input_is_parallel:
             raise RuntimeError(
                 "To enable `sequence_parallel_enabled`, "
                 "`input_is_parallel` must be `True`")  # apex parity
+        if overlap_chunks > 0 and not sequence_parallel_enabled:
+            raise RuntimeError(
+                "`overlap_chunks` rings the sequence-parallel "
+                "GEMM→reduce-scatter pair; it requires "
+                "`sequence_parallel_enabled=True`")
         self.input_size = int(input_size)
         self.output_size = int(output_size)
         self.use_bias = bool(bias)
         self.input_is_parallel = bool(input_is_parallel)
         self.skip_bias_add = bool(skip_bias_add)
         self.sequence_parallel_enabled = bool(sequence_parallel_enabled)
+        self.seq_dim = int(seq_dim)
+        self.overlap_chunks = int(overlap_chunks)
         self.axis_name = axis_name
         self.world_size = int(world_size) if world_size else 1
         self.input_size_per_partition = divide(self.input_size,
@@ -216,22 +250,47 @@ class RowParallelLinear:
     def __call__(self, params, x):
         if self.axis_name is not None and not self.input_is_parallel:
             x = M.scatter_to_tensor_model_parallel_region(x, self.axis_name)
+        if (self.axis_name is not None and self.sequence_parallel_enabled
+                and self.overlap_chunks > 0):
+            # GEMM and reduce-scatter fused into one ppermute ring (the
+            # custom VJP rings the backward gather + chunked wgrad too)
+            y = M.row_parallel_linear_overlap(
+                x, params["weight"], self.axis_name, self.seq_dim,
+                self.overlap_chunks)
+            bias = self._bias(params)
+            if self.skip_bias_add:
+                return y, bias
+            if bias is not None:
+                y = y + bias.astype(y.dtype)
+            return y, None
         # activation-dtype GEMM (see
         # linear_with_grad_accumulation_and_async_allreduce)
         y = x @ params["weight"].astype(x.dtype).T
         if self.axis_name is not None:
             if self.sequence_parallel_enabled:
                 y = M.reduce_scatter_to_sequence_parallel_region(
-                    y, self.axis_name)
+                    y, self.axis_name, self.seq_dim)
             else:
                 y = M.reduce_from_tensor_model_parallel_region(
                     y, self.axis_name)
-        bias = params.get("bias") if self.use_bias else None
+        bias = self._bias(params)
         if self.skip_bias_add:
             return y, bias
         if bias is not None:
             y = y + bias.astype(y.dtype)
         return y, None
+
+    def _bias(self, params):
+        bias = params.get("bias") if self.use_bias else None
+        if (bias is not None and self.sequence_parallel_enabled
+                and self.axis_name is not None):
+            # the bias lands on the SEQ-SHARDED output, so its cotangent
+            # per device only covers the local tokens; identity-fwd /
+            # psum-bwd restores the full grad (Megatron's allreduce of
+            # sequence-parallel-region bias grads)
+            bias = M.copy_to_tensor_model_parallel_region(
+                bias, self.axis_name)
+        return bias
 
     apply = __call__
 

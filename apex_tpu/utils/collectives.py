@@ -24,7 +24,8 @@ def ensure_varying(x, axis_name):
     return jax.tree_util.tree_map(cast, x)
 
 
-def shard_map_compat(fn, *, mesh, in_specs, out_specs, check: bool = False):
+def shard_map_compat(fn, *, mesh, in_specs, out_specs, check: bool = False,
+                     check_vma=None, check_rep=None):
     """``shard_map`` across the supported JAX version span.
 
     JAX 0.6+ exposes ``jax.shard_map`` whose consistency knob is
@@ -32,7 +33,13 @@ def shard_map_compat(fn, *, mesh, in_specs, out_specs, check: bool = False):
     with the older ``check_rep`` spelling.  ``check=False`` (the default
     here) is what every explicit-collective region in this package needs:
     gathered-but-replicated values fail both checkers' static inference.
+    ``check_vma``/``check_rep`` are accepted as aliases of ``check`` so
+    call sites written against either real API drop in unchanged.
     """
+    if check_vma is not None:
+        check = check_vma
+    elif check_rep is not None:
+        check = check_rep
     sm = getattr(jax, "shard_map", None)
     if sm is not None:
         try:

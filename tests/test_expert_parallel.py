@@ -6,7 +6,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from apex_tpu.utils.collectives import shard_map_compat as shard_map
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu.transformer.expert_parallel import MoEConfig, MoEMLP

@@ -8,7 +8,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from apex_tpu.utils.collectives import shard_map_compat as shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from apex_tpu.models.gpt import (GPTConfig, GPTModel, make_stage_fn,

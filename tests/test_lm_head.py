@@ -123,8 +123,9 @@ class TestGPTFusedHead:
                                        rtol=1e-4, atol=1e-6)
 
     def test_pipeline_head_matches_serial(self, rng):
-        from jax import shard_map
         from jax.sharding import PartitionSpec as P
+
+        from apex_tpu.utils.collectives import shard_map_compat as shard_map
 
         from apex_tpu.models.gpt import (GPTModel, pack_for_shard_map,
                                          pipeline_loss)

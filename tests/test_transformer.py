@@ -9,7 +9,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from apex_tpu.utils.collectives import shard_map_compat as shard_map
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu.transformer import parallel_state
@@ -37,7 +37,8 @@ def pp_mesh():
 def _rep(y, axis="model"):
     """Convert a value that is identical on all devices (e.g. all-gather
     output) into a provably-replicated one so out_specs=P() type-checks."""
-    return jax.lax.psum(y, axis) / jax.lax.axis_size(axis)
+    from apex_tpu.utils.collectives import axis_size
+    return jax.lax.psum(y, axis) / axis_size(axis)
 
 
 def shard_tp(fn, mesh, in_specs, out_specs):
