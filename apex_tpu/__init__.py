@@ -41,6 +41,7 @@ _SUBMODULES = (
     "ops",
     "optimizers",
     "parallel",
+    "resilience",
     "transformer",
     "utils",
 )

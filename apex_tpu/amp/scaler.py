@@ -26,6 +26,9 @@ class LossScaleState(NamedTuple):
     loss_scale: jax.Array        # f32 scalar
     unskipped: jax.Array         # int32 — clean steps since last growth
     overflows: jax.Array         # int32 — total overflow count (diagnostics)
+    skipped: jax.Array           # int32 — cumulative steps whose update
+    #                              was skipped (checkpointed; surfaced in
+    #                              GuardedTrainStep.stats)
 
 
 class LossScaler:
@@ -44,6 +47,7 @@ class LossScaler:
 
     def init(self) -> LossScaleState:
         return LossScaleState(jnp.asarray(self._init_scale, _f32),
+                              jnp.zeros((), jnp.int32),
                               jnp.zeros((), jnp.int32),
                               jnp.zeros((), jnp.int32))
 
@@ -74,10 +78,14 @@ class LossScaler:
 
     def update(self, state: LossScaleState, found_inf) -> LossScaleState:
         """Post-step scale adjustment (apex ``update_scale``): halve on
-        overflow, double every ``scale_window`` clean steps."""
-        if not self.dynamic:
-            return state
+        overflow, double every ``scale_window`` clean steps.  The
+        cumulative ``skipped`` counter advances on every overflow-skipped
+        step — including under a static scaler, where the scale itself
+        never moves."""
         overflow = jnp.asarray(found_inf) > 0
+        skipped = state.skipped + overflow.astype(jnp.int32)
+        if not self.dynamic:
+            return state._replace(skipped=skipped)
         new_scale = jnp.where(overflow,
                               state.loss_scale / self.scale_factor,
                               state.loss_scale)
@@ -90,15 +98,18 @@ class LossScaler:
                               self.max_loss_scale), new_scale)
         unskipped = jnp.where(grow, 0, unskipped)
         return LossScaleState(new_scale, unskipped,
-                              state.overflows + overflow.astype(jnp.int32))
+                              state.overflows + overflow.astype(jnp.int32),
+                              skipped)
 
     # apex checkpoint surface (tests/L0/run_amp/test_checkpointing.py)
     def state_dict(self, state: LossScaleState) -> dict:
         return {"loss_scale": float(state.loss_scale),
                 "unskipped": int(state.unskipped),
-                "overflows": int(state.overflows)}
+                "overflows": int(state.overflows),
+                "skipped": int(state.skipped)}
 
     def load_state_dict(self, d: dict) -> LossScaleState:
         return LossScaleState(jnp.asarray(d["loss_scale"], _f32),
                               jnp.asarray(d["unskipped"], jnp.int32),
-                              jnp.asarray(d.get("overflows", 0), jnp.int32))
+                              jnp.asarray(d.get("overflows", 0), jnp.int32),
+                              jnp.asarray(d.get("skipped", 0), jnp.int32))

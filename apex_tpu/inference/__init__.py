@@ -21,13 +21,15 @@ the decode attention kernel is
 :func:`apex_tpu.ops.flash_attention.flash_attention_decode`.
 """
 
-from apex_tpu.inference.engine import InferenceEngine, Request, Response
+from apex_tpu.inference.engine import (InferenceEngine, QueueFull, Request,
+                                       Response)
 from apex_tpu.inference.kv_cache import KVCache
 from apex_tpu.inference.sampling import SamplingParams, sample
 
 __all__ = [
     "InferenceEngine",
     "KVCache",
+    "QueueFull",
     "Request",
     "Response",
     "SamplingParams",

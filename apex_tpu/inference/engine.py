@@ -15,7 +15,18 @@ jitted programs — one prefill per prompt bucket and ONE batched
   batch drain, which is the point of continuous batching.
 * completion — eos / ``max_new_tokens`` / cache exhaustion free the
   slot; a request past its ``deadline`` is EVICTED mid-flight with
-  whatever it has generated.
+  whatever it has generated; a request past its per-request ``timeout``
+  (a budget relative to submission, distinct from the absolute
+  deadline) finishes with ``reason="timeout"``.
+
+Resilience (ISSUE 4): the engine loop must survive its inputs.
+``submit`` validates every ``Request`` field it can check statically and
+applies bounded-queue backpressure (:class:`QueueFull`); whatever
+validation can't catch — a sampling config that only detonates at
+decode time, a seed of the wrong type — is QUARANTINED: the per-request
+sampling/prefill work is wrapped so a poison request finishes with
+``reason="error"`` and frees its slot instead of raising out of
+``step()`` and killing every other request in flight.
 
 Determinism: each decode row depends only on its own slot's cache and
 token (attention masks by per-row length, norms/linears are per-token),
@@ -40,12 +51,22 @@ from apex_tpu.utils.platform import is_tpu_backend
 from apex_tpu.utils.profiling import ServingMetrics
 
 
+class QueueFull(RuntimeError):
+    """``submit`` refused a request: the bounded queue is at capacity.
+    Explicit backpressure — callers shed load or retry, instead of the
+    queue growing without bound until the host OOMs."""
+
+
 @dataclasses.dataclass
 class Request:
     """One generation request.
 
     ``deadline`` is an absolute value of the engine's ``clock`` (default
     ``time.monotonic``); a request still running past it is evicted.
+    ``timeout`` is a RELATIVE budget in clock units from submission —
+    queued or decoding, a request over budget finishes with
+    ``reason="timeout"`` (deadline eviction answers "the result is no
+    longer wanted"; timeout answers "this request used up its share").
     ``seed`` feeds the per-request sampling stream (stochastic modes
     only) — streams are keyed by (seed, token index), never by batch
     composition.
@@ -57,6 +78,7 @@ class Request:
     sampling: SamplingParams = dataclasses.field(
         default_factory=SamplingParams)
     deadline: Optional[float] = None
+    timeout: Optional[float] = None
     seed: int = 0
 
 
@@ -65,11 +87,14 @@ class Response:
     """Completed (or evicted) request: ``tokens`` holds the generated
     ids (including the eos token when one was emitted);
     ``finish_reason`` is ``"eos"``, ``"length"`` (max_new_tokens or
-    cache row exhausted) or ``"evicted"`` (deadline)."""
+    cache row exhausted), ``"evicted"`` (deadline), ``"timeout"``
+    (per-request budget) or ``"error"`` (poison request quarantined —
+    ``error`` carries the exception message)."""
     request_id: int
     prompt: List[int]
     tokens: List[int]
     finish_reason: str
+    error: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -88,7 +113,8 @@ class InferenceEngine:
                  max_seq: Optional[int] = None, cache_dtype=None,
                  clock: Callable[[], float] = time.monotonic,
                  metrics: Optional[ServingMetrics] = None,
-                 min_prompt_bucket: int = 8):
+                 min_prompt_bucket: int = 8,
+                 max_queue: Optional[int] = None):
         model._check_decode_supported()
         cfg = model.cfg
         self.model = model
@@ -99,8 +125,12 @@ class InferenceEngine:
         self.clock = clock
         self.metrics = metrics or ServingMetrics(clock)
         self._min_bucket = min_prompt_bucket
+        if max_queue is not None and max_queue < 1:
+            raise ValueError("max_queue must be >= 1 (or None: unbounded)")
+        self.max_queue = max_queue
         self._queue: collections.deque = collections.deque()
         self._active: dict = {}          # slot -> _Active
+        self._submit_time: dict = {}     # request_id -> submit clock value
         self._done: List[Response] = []
         # the cache buffer threads through every step: donate it on TPU
         # so XLA updates it in place (donation on CPU only warns)
@@ -110,13 +140,51 @@ class InferenceEngine:
 
     # -- request lifecycle ---------------------------------------------------
 
-    def submit(self, request: Request) -> None:
+    def _validate(self, request: Request) -> None:
+        """Reject statically-checkable poison at the door (what this
+        can't see — e.g. a sampling config that only fails at decode
+        time — the step-loop quarantine catches)."""
         if not 0 < len(request.prompt) < self.cache.max_seq:
             raise ValueError(
                 f"prompt length {len(request.prompt)} must be in "
                 f"(0, {self.cache.max_seq}) to leave room for decode")
+        vocab = self.model.cfg.vocab_size
+        for t in request.prompt:
+            if not isinstance(t, (int, np.integer)) or not 0 <= t < vocab:
+                raise ValueError(
+                    f"prompt token {t!r} is not an int in [0, {vocab})")
+        if not isinstance(request.max_new_tokens, (int, np.integer)) \
+                or request.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens {request.max_new_tokens!r} must be a "
+                "positive int")
+        if not isinstance(request.sampling, SamplingParams):
+            raise ValueError(
+                f"sampling must be a SamplingParams, got "
+                f"{type(request.sampling).__name__}")
+        if request.eos_id is not None and not isinstance(
+                request.eos_id, (int, np.integer)):
+            raise ValueError(f"eos_id {request.eos_id!r} must be an int")
+        if request.timeout is not None and not request.timeout > 0:
+            raise ValueError(
+                f"timeout {request.timeout!r} must be positive")
+
+    def submit(self, request: Request) -> None:
+        """Validate and enqueue; raises :class:`QueueFull` when the
+        bounded queue is at capacity (explicit backpressure — nothing is
+        silently dropped)."""
+        self._validate(request)
+        if self.max_queue is not None and len(self._queue) >= self.max_queue:
+            raise QueueFull(
+                f"submit queue is full ({len(self._queue)}/"
+                f"{self.max_queue}); retry after step() drains it")
+        self._submit_time[request.request_id] = self.clock()
         self.metrics.request_submitted(request.request_id)
         self._queue.append(request)
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
 
     def _bucket(self, n: int) -> int:
         b = self._min_bucket
@@ -131,14 +199,25 @@ class InferenceEngine:
                                  token_index)
         return int(sample(jnp.asarray(logits_row), req.sampling, key))
 
-    def _finish(self, slot: int, st: _Active, reason: str) -> None:
+    def _finish(self, slot: int, st: _Active, reason: str,
+                error: Optional[str] = None) -> None:
         self.cache.free(slot)
         del self._active[slot]
+        self._finish_response(st.request, st.generated, reason, error)
+
+    def _finish_response(self, req: Request, generated: List[int],
+                         reason: str, error: Optional[str] = None) -> None:
+        """Common completion tail for active AND still-queued requests:
+        metrics dispatch + the Response record."""
+        self._submit_time.pop(req.request_id, None)
         if reason == "evicted":
-            self.metrics.request_evicted(st.request.request_id)
-        self._done.append(Response(st.request.request_id,
-                                   list(st.request.prompt),
-                                   st.generated, reason))
+            self.metrics.request_evicted(req.request_id)
+        elif reason == "timeout":
+            self.metrics.request_timeout(req.request_id)
+        elif reason == "error":
+            self.metrics.request_error(req.request_id)
+        self._done.append(Response(req.request_id, list(req.prompt),
+                                   generated, reason, error=error))
 
     def _maybe_finish(self, slot: int, st: _Active) -> bool:
         req = st.request
@@ -156,19 +235,26 @@ class InferenceEngine:
         now = self.clock()
 
         def expired(req):
-            return req.deadline is not None and now >= req.deadline
+            # deadline wins when both trip the same tick: "no longer
+            # wanted" is the stronger statement than "over budget"
+            if req.deadline is not None and now >= req.deadline:
+                return "evicted"
+            if req.timeout is not None:
+                t0 = self._submit_time.get(req.request_id)
+                if t0 is not None and now - t0 >= req.timeout:
+                    return "timeout"
+            return None
 
-        for slot in [s for s, st in self._active.items()
-                     if expired(st.request)]:
-            self._finish(slot, self._active[slot], "evicted")
+        for slot in [s for s in sorted(self._active)
+                     if expired(self._active[s].request)]:
+            st = self._active[slot]
+            self._finish(slot, st, expired(st.request))
         keep: collections.deque = collections.deque()
         while self._queue:
             req = self._queue.popleft()
-            if expired(req):
-                self.metrics.request_evicted(req.request_id)
-                self._done.append(Response(req.request_id,
-                                           list(req.prompt), [],
-                                           "evicted"))
+            reason = expired(req)
+            if reason:
+                self._finish_response(req, [], reason)
             else:
                 keep.append(req)
         self._queue = keep
@@ -177,12 +263,19 @@ class InferenceEngine:
         while self._queue and self.cache.free_slots:
             req = self._queue.popleft()
             slot = self.cache.allocate()
-            plen = len(req.prompt)
-            toks = np.zeros((1, self._bucket(plen)), np.int32)
-            toks[0, :plen] = req.prompt
-            logits, kv = self._prefill(self.params, jnp.asarray(toks))
-            self.cache.write_prompt(slot, kv[:, :, 0], plen)
-            first = self._sample(req, np.asarray(logits[0, plen - 1]), 0)
+            try:
+                plen = len(req.prompt)
+                toks = np.zeros((1, self._bucket(plen)), np.int32)
+                toks[0, :plen] = req.prompt
+                logits, kv = self._prefill(self.params, jnp.asarray(toks))
+                self.cache.write_prompt(slot, kv[:, :, 0], plen)
+                first = self._sample(req, np.asarray(logits[0, plen - 1]),
+                                     0)
+            except Exception as e:          # quarantine: free the slot,
+                self.cache.free(slot)       # fail ONE request, keep going
+                self._finish_response(req, [], "error",
+                                      error=f"{type(e).__name__}: {e}")
+                continue
             self.metrics.first_token(req.request_id)
             st = _Active(req, plen, next_token=first, position=plen,
                          generated=[first])
@@ -212,8 +305,13 @@ class InferenceEngine:
         for slot in sorted(self._active):
             st = self._active[slot]
             self.cache.advance(slot)           # the fed token is cached now
-            tok = self._sample(st.request, logits_np[slot],
-                               len(st.generated))
+            try:
+                tok = self._sample(st.request, logits_np[slot],
+                                   len(st.generated))
+            except Exception as e:      # poison sampling config detonated
+                self._finish(slot, st, "error",
+                             error=f"{type(e).__name__}: {e}")
+                continue
             self.metrics.token(st.request.request_id)
             st.generated.append(tok)
             st.next_token = tok

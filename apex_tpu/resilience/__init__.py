@@ -1,0 +1,37 @@
+"""Fault tolerance for production training and serving (ISSUE 4).
+
+Three layers, composable but independently usable:
+
+* :mod:`~apex_tpu.resilience.checkpoint` — atomic, content-hashed,
+  shard-aware checkpointing with a ``latest``-symlink commit protocol
+  and async double-buffered writes (:class:`CheckpointManager`).
+* :mod:`~apex_tpu.resilience.guard` — :class:`GuardedTrainStep`, the
+  generalization of the amp loss-scaler's overflow skip: NaN/inf and
+  grad-norm-spike steps are skipped on-device, K consecutive anomalies
+  trigger rollback to the last complete checkpoint.
+* :mod:`~apex_tpu.resilience.faults` — :class:`FaultInjector`, a
+  deterministic seeded fault schedule (``nan_grads``, ``inf_loss``,
+  ``grad_spike``, ``preempt_at_step``, ``corrupt_checkpoint``,
+  ``slow_host``) threaded through the train loop and checkpoint IO so
+  every recovery path is exercised by tests and
+  ``tools/crash_matrix.py``.
+"""
+
+from apex_tpu.resilience.checkpoint import (CheckpointManager,
+                                            CheckpointNotFound)
+from apex_tpu.resilience.faults import (FAULT_KINDS, Fault, FaultInjector,
+                                        Preemption)
+from apex_tpu.resilience.guard import (GuardedTrainStep, GuardState,
+                                       StepResult)
+
+__all__ = [
+    "CheckpointManager",
+    "CheckpointNotFound",
+    "FAULT_KINDS",
+    "Fault",
+    "FaultInjector",
+    "Preemption",
+    "GuardedTrainStep",
+    "GuardState",
+    "StepResult",
+]

@@ -1,0 +1,154 @@
+"""Deterministic fault injection for the resilience test harness.
+
+A :class:`FaultInjector` owns a schedule of :class:`Fault` events —
+either written out explicitly or generated from a seed + per-kind rates
+(:meth:`FaultInjector.from_seed`), so a CI sweep can replay the exact
+same failure sequence on every run.  Kinds:
+
+=================== =========================================================
+``nan_grads``       every gradient leaf becomes NaN (device-side, in-jit)
+``inf_loss``        the loss becomes +inf (device-side, in-jit)
+``grad_spike``      gradients scaled by ``magnitude`` (default 64x)
+``preempt_at_step`` :class:`Preemption` raised before the step runs — the
+                    SIGTERM/maintenance-event analogue
+``corrupt_checkpoint`` the checkpoint committed at that step has payload
+                    bytes flipped post-commit (a torn write the manifest
+                    hash must catch)
+``slow_host``       the host sleeps ``magnitude`` seconds before the step
+                    (straggler simulation; surfaced in step timings)
+=================== =========================================================
+
+The in-jit kinds are injected as DATA, not control flow:
+:meth:`grad_flags` returns three scalars the guarded train step folds in
+with ``jnp.where``, so one compiled program serves both clean and
+faulty steps and injection never perturbs compilation caches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+FAULT_KINDS = ("nan_grads", "inf_loss", "grad_spike", "preempt_at_step",
+               "corrupt_checkpoint", "slow_host")
+
+
+class Preemption(RuntimeError):
+    """Raised by :meth:`FaultInjector.check_preempt` — the injected
+    equivalent of the scheduler killing the worker.  Train loops let it
+    propagate (a real preemption gives no chance to clean up); recovery
+    is restart + :meth:`CheckpointManager.restore`."""
+
+    def __init__(self, step: int):
+        super().__init__(f"injected preemption at step {step}")
+        self.step = step
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    """One scheduled fault.  ``magnitude`` is the spike factor for
+    ``grad_spike`` and the sleep seconds for ``slow_host``."""
+    step: int
+    kind: str
+    magnitude: float = 0.0
+
+    def __post_init__(self):
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r}; one of {FAULT_KINDS}")
+        if self.step < 0:
+            raise ValueError("fault step must be >= 0")
+
+
+class FaultInjector:
+    """Deterministic fault schedule threaded through train + IO paths."""
+
+    def __init__(self, schedule: Iterable[Fault] = ()):
+        self.schedule: Tuple[Fault, ...] = tuple(schedule)
+        self._by_step: Dict[int, List[Fault]] = {}
+        for f in self.schedule:
+            self._by_step.setdefault(f.step, []).append(f)
+        self.log: List[Tuple[int, str]] = []   # (step, kind) as applied
+
+    @classmethod
+    def from_seed(cls, seed: int, n_steps: int,
+                  rates: Optional[Dict[str, float]] = None, *,
+                  spike_magnitude: float = 64.0,
+                  slow_host_s: float = 0.01) -> "FaultInjector":
+        """Random-but-reproducible schedule: for each step and kind,
+        a fault fires with probability ``rates[kind]`` under a
+        ``RandomState(seed)`` stream — same seed, same schedule, always."""
+        rates = dict(rates or {})
+        bad = set(rates) - set(FAULT_KINDS)
+        if bad:
+            raise ValueError(f"unknown fault kinds in rates: {sorted(bad)}")
+        rng = np.random.RandomState(seed)
+        faults = []
+        for step in range(n_steps):
+            for kind in FAULT_KINDS:       # fixed order => reproducible
+                r = rates.get(kind, 0.0)
+                if r > 0.0 and rng.uniform() < r:
+                    mag = (spike_magnitude if kind == "grad_spike"
+                           else slow_host_s if kind == "slow_host" else 0.0)
+                    faults.append(Fault(step, kind, mag))
+        return cls(faults)
+
+    # -- queries -------------------------------------------------------------
+
+    def faults_at(self, step: int) -> Tuple[Fault, ...]:
+        return tuple(self._by_step.get(step, ()))
+
+    def _find(self, step: int, kind: str) -> Optional[Fault]:
+        for f in self._by_step.get(step, ()):
+            if f.kind == kind:
+                return f
+        return None
+
+    def record(self, step: int, kind: str) -> None:
+        """Append to the applied-fault log (callers record at the point
+        the fault actually lands, so the log is the ground truth tests
+        assert against)."""
+        self.log.append((int(step), kind))
+
+    # -- train-loop hooks ----------------------------------------------------
+
+    def grad_flags(self, step: int) -> Dict[str, float]:
+        """The in-jit injection scalars for this step:
+        ``{"nan_grads": 0/1, "inf_loss": 0/1, "spike_scale": s}`` —
+        identity values (0, 0, 1) on clean steps.  Folded into the
+        guarded step with ``jnp.where``; see
+        :class:`~apex_tpu.resilience.guard.GuardedTrainStep`."""
+        out = {"nan_grads": 0.0, "inf_loss": 0.0, "spike_scale": 1.0}
+        if self._find(step, "nan_grads"):
+            out["nan_grads"] = 1.0
+            self.record(step, "nan_grads")
+        if self._find(step, "inf_loss"):
+            out["inf_loss"] = 1.0
+            self.record(step, "inf_loss")
+        spike = self._find(step, "grad_spike")
+        if spike:
+            out["spike_scale"] = float(spike.magnitude or 64.0)
+            self.record(step, "grad_spike")
+        return out
+
+    def check_preempt(self, step: int) -> None:
+        if self._find(step, "preempt_at_step"):
+            self.record(step, "preempt_at_step")
+            raise Preemption(step)
+
+    def maybe_slow_host(self, step: int) -> None:
+        f = self._find(step, "slow_host")
+        if f:
+            self.record(step, "slow_host")
+            time.sleep(float(f.magnitude or 0.01))
+
+    # -- checkpoint-IO hook --------------------------------------------------
+
+    def should_corrupt(self, step: int) -> bool:
+        """True when the checkpoint committed at ``step`` must be
+        corrupted post-commit (the manager calls :meth:`record`
+        itself, after the bytes are actually flipped)."""
+        return self._find(step, "corrupt_checkpoint") is not None

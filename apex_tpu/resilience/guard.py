@@ -1,0 +1,282 @@
+"""Anomaly-guarded training — the loss-scaler overflow skip, generalized.
+
+Upstream apex's dynamic loss scaling already SKIPS a step whose scaled
+gradients overflow; at fleet scale the same treatment is needed for
+every step-level anomaly: NaN/inf loss (bad batch, race in the input
+pipeline), NaN/inf gradients (numerics), and gradient-norm spikes (the
+classic loss-blowup precursor).  :class:`GuardedTrainStep` wraps a loss
+function + fused optimizer into one jitted step that
+
+* detects: non-finite loss, non-finite gradient norm, and
+  ``‖g‖ > spike_factor × EMA(‖g‖)`` (EMA over clean steps only, armed
+  after ``warmup_steps``);
+* skips: the anomaly becomes the optimizer's on-device ``noop_flag`` —
+  params, moments and the step counter are untouched, exactly the
+  loss-scaler skip path, with no host sync inside the step;
+* records: per-kind counters in the device-side :class:`GuardState`
+  and host-side ``stats`` (which also surfaces the loss scaler's
+  cumulative ``skipped_steps``);
+* recovers: after ``max_consecutive`` anomalous steps in a row the
+  wrapper restores the last complete checkpoint from its attached
+  :class:`~apex_tpu.resilience.checkpoint.CheckpointManager` and
+  returns the restored state (``rolled_back=True``) — persistent
+  corruption cannot out-run the skip heuristic.
+
+Fault injection rides the same compiled program: the injector's
+per-step scalars fold in with ``jnp.where`` (data, not control flow),
+so clean and faulty steps share one XLA executable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_f32 = jnp.float32
+
+
+class GuardState(NamedTuple):
+    """Device-side guard state (checkpointable pytree)."""
+    ema_norm: jax.Array      # f32 — EMA of the unscaled grad norm
+    clean_steps: jax.Array   # int32 — steps that fed the EMA
+    consecutive: jax.Array   # int32 — current anomaly run length
+    anomalies: jax.Array     # int32 — total skipped steps
+    nonfinite: jax.Array     # int32 — NaN/inf loss-or-grad skips
+    spikes: jax.Array        # int32 — grad-norm-spike skips
+
+
+@dataclasses.dataclass
+class StepResult:
+    """Outcome of one guarded step.  ``next_step`` is the step index the
+    train loop should run next — ``step + 1`` normally, the restored
+    step after a rollback."""
+    loss: Any
+    params: Any
+    opt_state: Any
+    guard_state: GuardState
+    scaler_state: Any
+    grad_norm: float
+    skipped: bool
+    anomaly: Optional[str]          # "nonfinite" | "spike" | None
+    next_step: int
+    rolled_back: bool = False
+    restored_from: Optional[int] = None
+
+
+_CLEAN_FLAGS = {"nan_grads": 0.0, "inf_loss": 0.0, "spike_scale": 1.0}
+
+
+class GuardedTrainStep:
+    """``GuardedTrainStep(loss_fn, optimizer, ...)`` then
+    ``result = gstep(params, opt_state, gstate, *batch, step=i)``.
+
+    ``loss_fn(params, *batch) -> scalar``.  For gradients that need
+    their own collective context (e.g. a ``shard_map`` data-parallel
+    region) pass ``grad_fn(params, *batch) -> (loss, grads)`` instead.
+    ``scaler``/``scaler_state`` thread dynamic loss scaling through the
+    same skip: non-finite anomalies count as scaler overflows (halving
+    the scale and bumping its cumulative ``skipped`` counter) while
+    spikes skip at the guard level only.  ``checkpoint`` arms rollback;
+    call :meth:`save` from the train loop to keep it stocked.
+    """
+
+    def __init__(self, loss_fn: Optional[Callable] = None,
+                 optimizer=None, *, grad_fn: Optional[Callable] = None,
+                 scaler=None, spike_factor: float = 10.0,
+                 ema_decay: float = 0.99, warmup_steps: int = 5,
+                 max_consecutive: int = 3, checkpoint=None,
+                 fault_injector=None, lr=None):
+        if (loss_fn is None) == (grad_fn is None):
+            raise ValueError("pass exactly one of loss_fn / grad_fn")
+        if optimizer is None:
+            raise ValueError("an optimizer is required")
+        if grad_fn is not None and scaler is not None:
+            raise ValueError(
+                "scaler requires the loss_fn form (the guard scales the "
+                "loss before autodiff); with grad_fn, scale inside it")
+        self.loss_fn = loss_fn
+        self.grad_fn = grad_fn
+        self.optimizer = optimizer
+        self.scaler = scaler
+        self.spike_factor = float(spike_factor)
+        self.ema_decay = float(ema_decay)
+        self.warmup_steps = int(warmup_steps)
+        self.max_consecutive = int(max_consecutive)
+        self.checkpoint = checkpoint
+        self.fault_injector = fault_injector
+        self.lr = lr
+        self._compiled = jax.jit(self._raw_step)
+        self._consecutive = 0
+        self._last_sstate = None
+        self.counters = {"steps": 0, "skipped": 0, "nonfinite": 0,
+                         "spikes": 0, "rollbacks": 0}
+
+    def init_state(self) -> GuardState:
+        z32 = jnp.zeros((), jnp.int32)
+        return GuardState(jnp.zeros((), _f32), z32, z32, z32, z32, z32)
+
+    # -- the jitted step -----------------------------------------------------
+
+    def _raw_step(self, params, opt_state, gstate: GuardState, sstate,
+                  inj, *batch):
+        scaler = self.scaler
+        if self.grad_fn is not None:
+            loss, grads = self.grad_fn(params, *batch)
+        else:
+            def lf(p):
+                l = self.loss_fn(p, *batch)
+                if scaler is not None:
+                    l = l * sstate.loss_scale.astype(l.dtype)
+                return l
+            loss, grads = jax.value_and_grad(lf)(params)
+
+        # fault injection as data: identity on clean steps
+        loss = jnp.where(inj[1] > 0,
+                         jnp.asarray(jnp.inf, loss.dtype), loss)
+        nan = jnp.where(inj[0] > 0, jnp.asarray(jnp.nan, _f32),
+                        jnp.zeros((), _f32))
+        grads = jax.tree_util.tree_map(
+            lambda g: g * inj[2].astype(g.dtype) + nan.astype(g.dtype),
+            grads)
+
+        inv_scale = (1.0 / sstate.loss_scale if scaler is not None
+                     else jnp.ones((), _f32))
+        gsq = jnp.zeros((), _f32)
+        for g in jax.tree_util.tree_leaves(grads):
+            gsq = gsq + jnp.sum(jnp.square(g.astype(_f32)))
+        gnorm = jnp.sqrt(gsq) * inv_scale       # unscaled grad norm
+
+        bad = (~jnp.isfinite(loss.astype(_f32))) | (~jnp.isfinite(gnorm))
+        armed = gstate.clean_steps >= self.warmup_steps
+        spike = armed & ~bad & (gnorm > self.spike_factor
+                                * gstate.ema_norm)
+        anomaly = bad | spike
+        noop = anomaly.astype(jnp.int32)
+
+        new_params, new_opt = self.optimizer.step(
+            grads, params, opt_state, lr=self.lr, grad_scale=inv_scale,
+            noop_flag=noop)
+
+        first = gstate.clean_steps == 0
+        ema = jnp.where(
+            anomaly, gstate.ema_norm,
+            jnp.where(first, gnorm,
+                      self.ema_decay * gstate.ema_norm
+                      + (1.0 - self.ema_decay) * gnorm))
+        new_gstate = GuardState(
+            ema, gstate.clean_steps + (1 - noop),
+            jnp.where(anomaly, gstate.consecutive + 1, 0),
+            gstate.anomalies + noop,
+            gstate.nonfinite + bad.astype(jnp.int32),
+            gstate.spikes + spike.astype(jnp.int32))
+
+        if scaler is not None:
+            sstate = scaler.update(sstate, bad.astype(_f32))
+            loss = loss.astype(_f32) * inv_scale
+        flags = jnp.stack([anomaly, bad, spike]).astype(_f32)
+        return (loss, new_params, new_opt, new_gstate, sstate, flags,
+                gnorm)
+
+    # -- host wrapper --------------------------------------------------------
+
+    def __call__(self, params, opt_state, guard_state: GuardState, *batch,
+                 scaler_state=None, step: Optional[int] = None
+                 ) -> StepResult:
+        if (self.scaler is None) != (scaler_state is None):
+            raise ValueError("scaler_state must be passed iff the guard "
+                             "was built with a scaler")
+        if step is None:
+            step = self.counters["steps"]
+        inj = self.fault_injector
+        flags_in = _CLEAN_FLAGS
+        if inj is not None:
+            inj.check_preempt(step)     # raises Preemption — no cleanup
+            inj.maybe_slow_host(step)
+            flags_in = inj.grad_flags(step)
+        inj_arr = jnp.asarray([flags_in["nan_grads"], flags_in["inf_loss"],
+                               flags_in["spike_scale"]], _f32)
+        sstate = (scaler_state if scaler_state is not None
+                  else _null_scaler_state())
+        (loss, new_params, new_opt, new_gstate, new_sstate, flags,
+         gnorm) = self._compiled(params, opt_state, guard_state, sstate,
+                                 inj_arr, *batch)
+        anomaly_f, bad_f, spike_f = (float(x) for x in np.asarray(flags))
+        skipped = anomaly_f > 0
+        kind = ("nonfinite" if bad_f > 0
+                else "spike" if spike_f > 0 else None)
+        self.counters["steps"] += 1
+        self.counters["skipped"] += int(skipped)
+        self.counters["nonfinite"] += int(bad_f > 0)
+        self.counters["spikes"] += int(spike_f > 0)
+        self._consecutive = self._consecutive + 1 if skipped else 0
+        out_sstate = new_sstate if self.scaler is not None else None
+        self._last_sstate = out_sstate
+
+        if (skipped and self.checkpoint is not None
+                and self._consecutive >= self.max_consecutive):
+            restored, ck_step = self.checkpoint.restore(self._template(
+                params, opt_state, new_gstate, out_sstate))
+            self.counters["rollbacks"] += 1
+            self._consecutive = 0
+            return StepResult(
+                loss=loss, params=restored["params"],
+                opt_state=restored["opt"], guard_state=restored["guard"],
+                scaler_state=restored.get("scaler"),
+                grad_norm=float(gnorm), skipped=True, anomaly=kind,
+                next_step=int(np.asarray(restored["step"])),
+                rolled_back=True, restored_from=ck_step)
+        return StepResult(
+            loss=loss, params=new_params, opt_state=new_opt,
+            guard_state=new_gstate, scaler_state=out_sstate,
+            grad_norm=float(gnorm), skipped=skipped, anomaly=kind,
+            next_step=step + 1)
+
+    # -- checkpoint plumbing -------------------------------------------------
+
+    @staticmethod
+    def _template(params, opt_state, guard_state, scaler_state):
+        t = {"params": params, "opt": opt_state, "guard": guard_state,
+             "step": jnp.zeros((), jnp.int32)}
+        if scaler_state is not None:
+            t["scaler"] = scaler_state
+        return t
+
+    def save(self, next_step: int, params, opt_state,
+             guard_state: GuardState, scaler_state=None, *,
+             async_: bool = False) -> None:
+        """Checkpoint the full guarded train state.  ``next_step`` is
+        the step index training would run next (i.e. call this AFTER
+        step ``next_step - 1``); rollback resumes there."""
+        if self.checkpoint is None:
+            raise ValueError("no CheckpointManager attached")
+        state = self._template(params, opt_state, guard_state,
+                               scaler_state)
+        state["step"] = jnp.asarray(next_step, jnp.int32)
+        if async_:
+            self.checkpoint.save_async(next_step, state)
+        else:
+            self.checkpoint.save(next_step, state)
+
+    @property
+    def stats(self) -> dict:
+        """Host-side counters; includes the scaler's cumulative
+        ``skipped_steps`` when dynamic loss scaling is attached."""
+        out = dict(self.counters)
+        if self._last_sstate is not None:
+            out["scaler_skipped_steps"] = int(self._last_sstate.skipped)
+        return out
+
+
+# placeholder threaded through the jitted signature when no scaler is
+# attached (never read: every use is behind `scaler is not None`)
+class _NullScalerState(NamedTuple):
+    loss_scale: jax.Array
+
+
+def _null_scaler_state() -> _NullScalerState:
+    # built lazily: module import must not initialize the jax backend
+    return _NullScalerState(jnp.ones((), _f32))

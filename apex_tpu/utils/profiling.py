@@ -154,6 +154,8 @@ class ServingMetrics:
         self.occupancy: list = []        # (active, total) per engine step
         self.tokens_emitted = 0
         self.evicted = 0                 # deadline evictions (active+queued)
+        self.errors = 0                  # poison requests quarantined
+        self.timeouts = 0                # per-request timeout expiries
         self._started: float | None = None
 
     def request_submitted(self, request_id) -> None:
@@ -184,6 +186,16 @@ class ServingMetrics:
         that never reached first_token left no trace in ``summary``)."""
         self.evicted += 1
 
+    def request_error(self, request_id) -> None:
+        """A poison request was quarantined (its sampling/decode raised);
+        the engine finished it with ``reason="error"`` instead of dying."""
+        self.errors += 1
+
+    def request_timeout(self, request_id) -> None:
+        """A request exceeded its per-request ``timeout`` budget
+        (distinct from absolute-``deadline`` eviction)."""
+        self.timeouts += 1
+
     @staticmethod
     def _pct(xs, q):
         if not xs:
@@ -200,6 +212,8 @@ class ServingMetrics:
             "requests": len(self.ttft),
             "tokens": self.tokens_emitted,
             "evicted": self.evicted,
+            "errors": self.errors,
+            "timeouts": self.timeouts,
             "tokens_per_s": (self.tokens_emitted / elapsed
                              if elapsed > 0 else 0.0),
             "ttft_p50_s": self._pct(list(self.ttft.values()), 0.5),

@@ -28,7 +28,8 @@ import sys
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 PACKAGES = ["apex_tpu.amp", "apex_tpu.optimizers", "apex_tpu.transformer",
-            "apex_tpu.parallel", "apex_tpu.inference"]
+            "apex_tpu.parallel", "apex_tpu.inference",
+            "apex_tpu.resilience"]
 
 _PAGE = """<!doctype html>
 <html><head><meta charset="utf-8"><title>{title}</title>

@@ -1,0 +1,286 @@
+#!/usr/bin/env python
+"""Kill-matrix sweep for the resilience stack (ISSUE 4 satellite).
+
+``tests/test_resilience.py`` and the ``__graft_entry__`` dryrun prove
+kill-and-resume parity at ONE kill step; this tool sweeps the full
+matrix — every kill step x every fault kind — and prints one PASS/FAIL
+cell per combination:
+
+* ``preempt``           — :class:`Preemption` raised before the kill
+  step runs; a fresh manager restores the latest complete checkpoint
+  and the resumed run must match the uninterrupted run BITWISE (f32
+  params and optimizer slots) after ``--steps`` total steps.
+* ``corrupt``           — same preemption, but the latest checkpoint's
+  payload is also torn post-commit; restore must detect the sha256
+  mismatch, fall back one step, and the resumed run (replaying the
+  lost step) must STILL be bitwise identical.
+* ``nan`` / ``inf`` / ``spike`` — the anomaly fires AT the kill step
+  instead of a preemption; the guard must skip exactly that one update
+  (optimizer state stays consistent) and the run must finish with
+  finite parameters.
+
+Runs on the fake 8-device CPU mesh by default (same two-lane contract
+as ``tests/conftest.py``); ``APEX_TPU_ON_CHIP=1`` leaves the real
+backend in place.  ``--sp`` adds the dp=2 x tp=2 sequence-parallel GPT
+component next to the default dp=2 data-parallel one.
+
+Usage::
+
+    python tools/crash_matrix.py [--steps 5] [--sp]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import sys
+import tempfile
+import warnings
+
+# env must be set before jax initializes (see tests/conftest.py)
+ON_CHIP = os.environ.get("APEX_TPU_ON_CHIP") == "1"
+if not ON_CHIP:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+if not ON_CHIP:
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.sharding import NamedSharding  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+from apex_tpu.models.gpt import (GPTConfig, GPTModel,  # noqa: E402
+                                 pack_for_shard_map)
+from apex_tpu.optimizers import FusedAdam  # noqa: E402
+from apex_tpu.resilience import (CheckpointManager,  # noqa: E402
+                                 CheckpointNotFound, Fault, FaultInjector,
+                                 GuardedTrainStep, Preemption)
+from apex_tpu.utils.collectives import shard_map_compat  # noqa: E402
+
+ANOMALY_KINDS = {"nan": "nan_grads", "inf": "inf_loss",
+                 "spike": "grad_spike"}
+
+
+def _tree_bitwise(a, b) -> bool:
+    for x, y in zip(jax.tree_util.tree_leaves(a),
+                    jax.tree_util.tree_leaves(b), strict=True):
+        if not np.array_equal(np.asarray(x), np.asarray(y)):
+            return False
+    return True
+
+
+def _drive(guard, params, opt_state, gstate, batch_fn, n_steps,
+           start=0):
+    step = start
+    while step < n_steps:
+        x, y = batch_fn(step)
+        res = guard(params, opt_state, gstate, x, y, step=step)
+        params, opt_state, gstate = (res.params, res.opt_state,
+                                     res.guard_state)
+        step = res.next_step
+        guard.save(step, params, opt_state, gstate)
+    return params, opt_state
+
+
+def _run_cell(make_parts, batch_fn, n_steps, kill_at, fault, ref):
+    """One matrix cell; returns (ok, detail)."""
+    root = tempfile.mkdtemp(prefix="apex_tpu_crash_")
+    try:
+        if fault in ANOMALY_KINDS:
+            # anomaly at kill_at: no restart — the guard must skip
+            # exactly that one update and the run must end finite
+            inj = FaultInjector([Fault(step=kill_at,
+                                       kind=ANOMALY_KINDS[fault],
+                                       magnitude=1e6)])
+            guard, params, opt_state, gstate = make_parts(root, inj)
+            got_p, _ = _drive(guard, params, opt_state, gstate,
+                              batch_fn, n_steps)
+            if guard.counters["skipped"] != 1:
+                return False, f"skipped={guard.counters['skipped']}"
+            for leaf in jax.tree_util.tree_leaves(got_p):
+                if not np.all(np.isfinite(np.asarray(leaf))):
+                    return False, "non-finite params leaked through"
+            return True, f"skipped@{kill_at}"
+
+        faults = [Fault(step=kill_at, kind="preempt_at_step")]
+        if fault == "corrupt":
+            # tear the last checkpoint that commits before the kill
+            faults.append(Fault(step=kill_at, kind="corrupt_checkpoint"))
+        inj = FaultInjector(faults)
+        guard, params, opt_state, gstate = make_parts(root, inj)
+        try:
+            _drive(guard, params, opt_state, gstate, batch_fn, n_steps)
+            return False, "preemption did not fire"
+        except Preemption:
+            pass
+
+        # fresh restart: only the checkpoint directory survives
+        guard2, p0, o0, g0 = make_parts(root, None)
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")  # corruption noise
+                restored, ck_step = guard2.checkpoint.restore(
+                    guard2._template(p0, o0, g0, None))
+            start = int(np.asarray(restored["step"]))
+            p, o, g = (restored["params"], restored["opt"],
+                       restored["guard"])
+        except CheckpointNotFound:
+            # every candidate torn (corrupt at kill@1): start over —
+            # the init state is deterministic, so parity must still hold
+            ck_step, start, p, o, g = 0, 0, p0, o0, g0
+        expect = kill_at - 1 if fault == "corrupt" else kill_at
+        if ck_step != expect:
+            return False, f"resumed@{ck_step}, expected {expect}"
+        got_p, got_o = _drive(guard2, p, o, g, batch_fn, n_steps,
+                              start=start)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    if not _tree_bitwise(got_p, ref[0]):
+        return False, "params diverged"
+    if not _tree_bitwise(got_o, ref[1]):
+        return False, "opt slots diverged"
+    return True, f"resume@{ck_step} bitwise"
+
+
+def _component_dp2():
+    mesh = jax.make_mesh((2,), ("data",), devices=jax.devices()[:2])
+
+    def loss_fn(p, x, y):
+        return jnp.mean(jnp.square(x @ p["w"] + p["b"] - y))
+
+    def body(p, x, y):
+        loss, g = jax.value_and_grad(loss_fn)(p, x, y)
+        return (jax.lax.pmean(loss, "data"),
+                jax.tree_util.tree_map(
+                    lambda a: jax.lax.pmean(a, "data"), g))
+
+    grad_fn = shard_map_compat(body, mesh=mesh,
+                               in_specs=(P(), P("data"), P("data")),
+                               out_specs=(P(), P()))
+
+    def make_parts(ckpt_dir, injector):
+        opt = FusedAdam(lr=1e-2)
+        guard = GuardedTrainStep(
+            grad_fn=grad_fn, optimizer=opt, warmup_steps=1,
+            checkpoint=CheckpointManager(ckpt_dir, keep=3,
+                                         fault_injector=injector),
+            fault_injector=injector)
+        r = np.random.RandomState(0)
+        rep = NamedSharding(mesh, P())
+        params = jax.device_put(
+            {"w": jnp.asarray(r.randn(8, 4).astype(np.float32)),
+             "b": jnp.zeros((4,), jnp.float32)}, rep)
+        return (guard, params, jax.device_put(opt.init(params), rep),
+                jax.device_put(guard.init_state(), rep))
+
+    def batch_fn(step):
+        r = np.random.RandomState(50_000 + step)
+        return (jnp.asarray(r.randn(8, 8).astype(np.float32)),
+                jnp.asarray(r.randn(8, 4).astype(np.float32)))
+
+    return make_parts, batch_fn
+
+
+def _component_dp2tp2_sp():
+    kw = dict(vocab_size=32, hidden_size=16, num_layers=2,
+              num_attention_heads=4, max_seq_len=8)
+    par = GPTModel(GPTConfig(tensor_parallel_size=2, axis_name="model",
+                             sequence_parallel=True, **kw))
+    init = GPTModel(GPTConfig(**kw)).init_params(jax.random.PRNGKey(9))
+    mesh = jax.make_mesh((2, 2), ("data", "model"),
+                         devices=jax.devices()[:4])
+    packed, in_specs, local_fn, repack_fn = pack_for_shard_map(par, init)
+
+    def body(sp, tk, tg):
+        loss, g = jax.value_and_grad(par.loss)(local_fn(sp), tk, tg)
+        return (jax.lax.pmean(loss, "data"),
+                jax.tree_util.tree_map(
+                    lambda a: jax.lax.pmean(a, "data"), repack_fn(g)))
+
+    grad_fn = shard_map_compat(body, mesh=mesh,
+                               in_specs=(in_specs, P("data"), P("data")),
+                               out_specs=(P(), in_specs))
+
+    def make_parts(ckpt_dir, injector):
+        opt = FusedAdam(lr=1e-2)
+        guard = GuardedTrainStep(
+            grad_fn=grad_fn, optimizer=opt, warmup_steps=1,
+            checkpoint=CheckpointManager(ckpt_dir, keep=3,
+                                         fault_injector=injector),
+            fault_injector=injector)
+        rep = NamedSharding(mesh, P())
+        p = jax.device_put(packed, rep)
+        return (guard, p, jax.device_put(opt.init(p), rep),
+                jax.device_put(guard.init_state(), rep))
+
+    def batch_fn(step):
+        r = np.random.RandomState(50_000 + step)
+        return (jnp.asarray(r.randint(0, 32, (4, 8))),
+                jnp.asarray(r.randint(0, 32, (4, 8))))
+
+    return make_parts, batch_fn
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--steps", type=int, default=5,
+                    help="total train steps per run (default 5)")
+    ap.add_argument("--sp", action="store_true",
+                    help="also sweep the dp=2 x tp=2 + SP GPT component")
+    args = ap.parse_args(argv)
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        print(f"crash_matrix: needs >=2 devices, have {n_dev} — skipped")
+        return 0
+
+    components = [("dp2", _component_dp2)]
+    if args.sp:
+        if n_dev < 4:
+            print("crash_matrix: --sp needs >=4 devices — skipped")
+        else:
+            components.append(("dp2xtp2+sp", _component_dp2tp2_sp))
+
+    faults = ["preempt", "corrupt", "nan", "inf", "spike"]
+    kill_steps = range(1, args.steps)   # step 0 has no checkpoint yet
+    failures = 0
+    for name, build in components:
+        make_parts, batch_fn = build()
+        # the reference arm: one clean uninterrupted run per component
+        guard, params, opt_state, gstate = make_parts(
+            tempfile.mkdtemp(prefix="apex_tpu_crash_ref_"), None)
+        ref = _drive(guard, params, opt_state, gstate, batch_fn,
+                     args.steps)
+        shutil.rmtree(guard.checkpoint.directory, ignore_errors=True)
+
+        print(f"\ncomponent: {name}  ({args.steps} steps)")
+        header = "kill@ " + "".join(f"{f:>10}" for f in faults)
+        print(header)
+        for k in kill_steps:
+            cells = []
+            for fault in faults:
+                ok, detail = _run_cell(make_parts, batch_fn, args.steps,
+                                       k, fault, ref)
+                cells.append("PASS" if ok else "FAIL")
+                if not ok:
+                    failures += 1
+                    print(f"  FAIL {name} kill@{k} {fault}: {detail}")
+            print(f"{k:>5} " + "".join(f"{c:>10}" for c in cells))
+
+    print(f"\ncrash_matrix: {'OK' if failures == 0 else 'FAILED'} "
+          f"({failures} failing cell(s))")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
