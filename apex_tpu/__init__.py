@@ -38,6 +38,7 @@ _SUBMODULES = (
     "models",
     "multi_tensor_apply",
     "normalization",
+    "observability",
     "ops",
     "optimizers",
     "parallel",

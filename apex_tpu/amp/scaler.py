@@ -101,6 +101,18 @@ class LossScaler:
                               state.overflows + overflow.astype(jnp.int32),
                               skipped)
 
+    def stats(self, state: LossScaleState) -> dict:
+        """Observability tap: the scaler's series as host floats/ints
+        (one 4-scalar readback — call at report time, not per step;
+        the per-step loss-scale series rides the guard's telemetry
+        vector instead).  Consumed by
+        ``apex_tpu.observability.TrainingMonitor.report``."""
+        return {"loss_scale": float(state.loss_scale),
+                "overflows": int(state.overflows),
+                "skipped_steps": int(state.skipped),
+                "steps_since_backoff": int(state.unskipped),
+                "dynamic": self.dynamic}
+
     # apex checkpoint surface (tests/L0/run_amp/test_checkpointing.py)
     def state_dict(self, state: LossScaleState) -> dict:
         return {"loss_scale": float(state.loss_scale),

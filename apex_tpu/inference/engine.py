@@ -113,6 +113,7 @@ class InferenceEngine:
                  max_seq: Optional[int] = None, cache_dtype=None,
                  clock: Callable[[], float] = time.monotonic,
                  metrics: Optional[ServingMetrics] = None,
+                 registry=None,
                  min_prompt_bucket: int = 8,
                  max_queue: Optional[int] = None):
         model._check_decode_supported()
@@ -123,7 +124,10 @@ class InferenceEngine:
                              max_seq or cfg.max_seq_len, cfg.local_heads,
                              cfg.head_dim, cache_dtype or cfg.dtype)
         self.clock = clock
-        self.metrics = metrics or ServingMetrics(clock)
+        # `registry` merges this engine's serving series into a shared
+        # apex_tpu.observability.MetricsRegistry (one Prometheus/JSONL
+        # sink for training + serving); ignored when `metrics` is given
+        self.metrics = metrics or ServingMetrics(clock, registry=registry)
         self._min_bucket = min_prompt_bucket
         if max_queue is not None and max_queue < 1:
             raise ValueError("max_queue must be >= 1 (or None: unbounded)")
@@ -216,6 +220,12 @@ class InferenceEngine:
             self.metrics.request_timeout(req.request_id)
         elif reason == "error":
             self.metrics.request_error(req.request_id)
+        else:
+            # eos/length: the metrics layer drops the request's
+            # transient state (TTFT bookkeeping) — every terminal path
+            # must reach ServingMetrics or the engine leaks an entry
+            # per request
+            self.metrics.request_finished(req.request_id, reason)
         self._done.append(Response(req.request_id, list(req.prompt),
                                    generated, reason, error=error))
 

@@ -1,0 +1,56 @@
+"""apex_tpu.observability — unified telemetry for training + serving.
+
+One registry, four surfaces:
+
+* :mod:`~apex_tpu.observability.registry` — labeled
+  Counter/Gauge/Histogram :class:`MetricsRegistry` with an append-only
+  JSONL event stream and a Prometheus text-format snapshot;
+* :mod:`~apex_tpu.observability.spans` — host-side span tracing
+  (:func:`span`) emitting Chrome trace-event JSON (Perfetto-loadable),
+  sharing names with device ``jax.named_scope`` annotations;
+* :mod:`~apex_tpu.observability.train_monitor` —
+  :class:`TrainingMonitor`, wrapping any train step (notably
+  :class:`~apex_tpu.resilience.GuardedTrainStep`) into step-time /
+  tokens-s / MFU / grad-norm / loss-scale / anomaly series;
+* :mod:`~apex_tpu.observability.comms` — static per-collective byte
+  accounting (:func:`collective_stats`) from compiled HLO.
+
+``tools/metrics_report.py`` renders a JSONL stream into a human
+summary; ``docs/source/observability.md`` is the user guide.
+"""
+
+from apex_tpu.observability.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    replay_jsonl,
+)
+from apex_tpu.observability.spans import Tracer, default_tracer, span
+from apex_tpu.observability.train_monitor import (
+    TrainingMonitor,
+    calibrated_peak_flops,
+)
+from apex_tpu.observability.comms import (
+    collective_stats,
+    format_stats,
+    hlo_collective_stats,
+    wire_bytes,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "replay_jsonl",
+    "Tracer",
+    "default_tracer",
+    "span",
+    "TrainingMonitor",
+    "calibrated_peak_flops",
+    "collective_stats",
+    "format_stats",
+    "hlo_collective_stats",
+    "wire_bytes",
+]

@@ -1,0 +1,130 @@
+"""Host-side span tracing -> Chrome trace-event JSON (Perfetto-loadable).
+
+``jax.profiler`` traces the DEVICE; what it cannot see is the host-side
+orchestration around it — admission loops, sampling, checkpoint
+serialization, the train loop's data stalls.  :func:`span` records those
+as wall-clock spans:
+
+    with span("prefill"):
+        logits, kv = prefill(params, tokens)
+
+Spans nest per thread (a span closed out of order raises — the same
+contract as ``profiling.range_push/pop``) and every span ALSO enters
+``jax.named_scope`` with the same name by default, so ops traced inside
+carry the name into XLA HLO metadata: the host span in the Perfetto
+timeline and the device scope in xprof share one vocabulary.
+
+Events use the Chrome trace-event format (``ph: "X"`` complete events,
+microsecond timestamps, pid/tid) — ``Tracer.save(path)`` writes a file
+that chrome://tracing and https://ui.perfetto.dev open directly.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+import jax
+
+
+class Tracer:
+    """Collects spans into a Chrome trace-event list.  Thread-safe;
+    ``clock`` is injectable (seconds; default ``time.perf_counter``)."""
+
+    def __init__(self, clock=time.perf_counter):
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._events: list = []
+        self._local = threading.local()
+
+    # -- recording -----------------------------------------------------------
+
+    def _stack(self) -> list:
+        if not hasattr(self._local, "stack"):
+            self._local.stack = []
+        return self._local.stack
+
+    def depth(self) -> int:
+        """Current span nesting depth on THIS thread."""
+        return len(self._stack())
+
+    @contextlib.contextmanager
+    def span(self, name: str, device: bool = True, **args):
+        """Time a host-side region.  ``device=True`` (default) also
+        enters ``jax.named_scope(name)`` so device ops traced inside
+        carry the same name in HLO metadata; ``args`` become the trace
+        event's ``args`` payload."""
+        stack = self._stack()
+        stack.append(name)
+        depth = len(stack)
+        t0 = self.clock()
+        cm = jax.named_scope(name) if device else contextlib.nullcontext()
+        try:
+            with cm:
+                yield
+        finally:
+            dt = self.clock() - t0
+            popped = stack.pop()
+            if popped != name:            # pragma: no cover - defensive
+                raise RuntimeError(
+                    f"span nesting violated: closing {name!r}, "
+                    f"top of stack is {popped!r}")
+            ev = {"name": name, "ph": "X", "cat": "host",
+                  "ts": t0 * 1e6, "dur": dt * 1e6,
+                  "pid": os.getpid(), "tid": threading.get_ident()}
+            if args or depth > 1:
+                ev["args"] = {**args, "depth": depth}
+            with self._lock:
+                self._events.append(ev)
+
+    def instant(self, name: str, **args) -> None:
+        """A zero-duration marker (trace-event ``ph: "i"``) — step
+        boundaries, rollbacks, admissions."""
+        ev = {"name": name, "ph": "i", "cat": "host", "s": "t",
+              "ts": self.clock() * 1e6,
+              "pid": os.getpid(), "tid": threading.get_ident()}
+        if args:
+            ev["args"] = dict(args)
+        with self._lock:
+            self._events.append(ev)
+
+    # -- export --------------------------------------------------------------
+
+    @property
+    def events(self) -> list:
+        with self._lock:
+            return list(self._events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events = []
+
+    def to_json(self) -> str:
+        """Chrome trace-event JSON (the ``traceEvents`` object form)."""
+        return json.dumps({"traceEvents": self.events,
+                           "displayTimeUnit": "ms"})
+
+    def save(self, path: str) -> str:
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(self.to_json())
+        return path
+
+
+# module-level default tracer: `from apex_tpu.observability import span`
+# is the whole integration for most call sites
+_DEFAULT = Tracer()
+
+
+def default_tracer() -> Tracer:
+    return _DEFAULT
+
+
+def span(name: str, device: bool = True, *,
+         tracer: Optional[Tracer] = None, **args):
+    """``with span("prefill"): ...`` on the default tracer (or an
+    explicit one via ``tracer=``)."""
+    return (tracer or _DEFAULT).span(name, device=device, **args)

@@ -53,7 +53,13 @@ class GuardState(NamedTuple):
 class StepResult:
     """Outcome of one guarded step.  ``next_step`` is the step index the
     train loop should run next — ``step + 1`` normally, the restored
-    step after a rollback."""
+    step after a rollback.  ``loss_value``/``loss_scale_value`` are host
+    floats materialized by the SAME single readback that carries the
+    anomaly flags (one 6-element transfer per step, replacing the old
+    flags + grad-norm pair) — the telemetry tap the observability
+    layer's ``TrainingMonitor`` reads without adding device→host
+    syncs.  ``loss_scale_value`` is ``None`` when no scaler is
+    attached."""
     loss: Any
     params: Any
     opt_state: Any
@@ -65,6 +71,8 @@ class StepResult:
     next_step: int
     rolled_back: bool = False
     restored_from: Optional[int] = None
+    loss_value: float = float("nan")
+    loss_scale_value: Optional[float] = None
 
 
 _CLEAN_FLAGS = {"nan_grads": 0.0, "inf_loss": 0.0, "spike_scale": 1.0}
@@ -177,9 +185,13 @@ class GuardedTrainStep:
         if scaler is not None:
             sstate = scaler.update(sstate, bad.astype(_f32))
             loss = loss.astype(_f32) * inv_scale
-        flags = jnp.stack([anomaly, bad, spike]).astype(_f32)
-        return (loss, new_params, new_opt, new_gstate, sstate, flags,
-                gnorm)
+        # one telemetry vector = one device->host transfer on the host
+        # side: anomaly flags + grad norm + loss + (post-update) loss
+        # scale all materialize together
+        telemetry = jnp.stack([
+            anomaly.astype(_f32), bad.astype(_f32), spike.astype(_f32),
+            gnorm, loss.astype(_f32), sstate.loss_scale.astype(_f32)])
+        return (loss, new_params, new_opt, new_gstate, sstate, telemetry)
 
     # -- host wrapper --------------------------------------------------------
 
@@ -201,10 +213,11 @@ class GuardedTrainStep:
                                flags_in["spike_scale"]], _f32)
         sstate = (scaler_state if scaler_state is not None
                   else _null_scaler_state())
-        (loss, new_params, new_opt, new_gstate, new_sstate, flags,
-         gnorm) = self._compiled(params, opt_state, guard_state, sstate,
-                                 inj_arr, *batch)
-        anomaly_f, bad_f, spike_f = (float(x) for x in np.asarray(flags))
+        (loss, new_params, new_opt, new_gstate, new_sstate,
+         telemetry) = self._compiled(params, opt_state, guard_state,
+                                     sstate, inj_arr, *batch)
+        (anomaly_f, bad_f, spike_f, gnorm_f, loss_f,
+         scale_f) = (float(x) for x in np.asarray(telemetry))
         skipped = anomaly_f > 0
         kind = ("nonfinite" if bad_f > 0
                 else "spike" if spike_f > 0 else None)
@@ -215,6 +228,7 @@ class GuardedTrainStep:
         self._consecutive = self._consecutive + 1 if skipped else 0
         out_sstate = new_sstate if self.scaler is not None else None
         self._last_sstate = out_sstate
+        out_scale = scale_f if self.scaler is not None else None
 
         if (skipped and self.checkpoint is not None
                 and self._consecutive >= self.max_consecutive):
@@ -226,14 +240,16 @@ class GuardedTrainStep:
                 loss=loss, params=restored["params"],
                 opt_state=restored["opt"], guard_state=restored["guard"],
                 scaler_state=restored.get("scaler"),
-                grad_norm=float(gnorm), skipped=True, anomaly=kind,
+                grad_norm=gnorm_f, skipped=True, anomaly=kind,
                 next_step=int(np.asarray(restored["step"])),
-                rolled_back=True, restored_from=ck_step)
+                rolled_back=True, restored_from=ck_step,
+                loss_value=loss_f, loss_scale_value=out_scale)
         return StepResult(
             loss=loss, params=new_params, opt_state=new_opt,
             guard_state=new_gstate, scaler_state=out_sstate,
-            grad_norm=float(gnorm), skipped=skipped, anomaly=kind,
-            next_step=step + 1)
+            grad_norm=gnorm_f, skipped=skipped, anomaly=kind,
+            next_step=step + 1, loss_value=loss_f,
+            loss_scale_value=out_scale)
 
     # -- checkpoint plumbing -------------------------------------------------
 

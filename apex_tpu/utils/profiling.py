@@ -17,7 +17,9 @@ Nsight.  The TPU equivalents wired here:
   programs; hash the optimized HLO and compare.
 * :class:`ServingMetrics` — inference-serving observability (TTFT,
   per-token latency, slot occupancy, tokens/s) for
-  ``apex_tpu.inference``'s continuous-batching engine.
+  ``apex_tpu.inference``'s continuous-batching engine; backed by the
+  :mod:`apex_tpu.observability` metrics registry, so serving series
+  export as Prometheus text / JSONL next to training telemetry.
 """
 
 from __future__ import annotations
@@ -26,13 +28,15 @@ import contextlib
 import hashlib
 import threading
 import time
-from typing import Any, Callable
+import warnings
+from typing import Any, Callable, Optional
 
 import jax
 
 named_scope = jax.named_scope        # re-export: the nvtx range analogue
 
 _SCOPES = threading.local()          # per-thread stack: pops must nest
+_POP_MISMATCH_WARNED = False         # warn-once flag for unmatched pops
 
 
 def range_push(name: str) -> None:
@@ -51,9 +55,29 @@ def range_push(name: str) -> None:
 
 
 def range_pop() -> None:
+    """Pop the innermost :func:`range_push` scope on this thread.
+
+    An unmatched pop (empty stack) is a caller bug — annotations above
+    it are silently mis-nested from that point on — so it warns (once
+    per process; nvtx printed an error per event, which floods) instead
+    of no-opping invisibly."""
     stack = getattr(_SCOPES, "stack", None)
     if stack:
         stack.pop().__exit__(None, None, None)
+        return
+    global _POP_MISMATCH_WARNED
+    if not _POP_MISMATCH_WARNED:
+        _POP_MISMATCH_WARNED = True
+        warnings.warn(
+            "range_pop() with no matching range_push() on this thread — "
+            "push/pop pairs are mis-nested (warning once per process)",
+            RuntimeWarning, stacklevel=2)
+
+
+def range_depth() -> int:
+    """Current :func:`range_push` nesting depth on THIS thread (tests
+    assert push/pop balance with it)."""
+    return len(getattr(_SCOPES, "stack", None) or ())
 
 
 @contextlib.contextmanager
@@ -143,10 +167,29 @@ class ServingMetrics:
     whole.  ``clock`` is injectable (tests pass a fake counter) and
     defaults to ``time.monotonic``.  All aggregation is lazy —
     :meth:`summary` computes percentiles over whatever has been recorded.
+
+    Since the observability PR this is a thin wrapper over a
+    :class:`~apex_tpu.observability.MetricsRegistry` — every recording
+    ALSO feeds registry counters/histograms (``serving_*`` series), so
+    an engine's metrics export as Prometheus text or a JSONL stream
+    alongside training telemetry.  Pass a shared ``registry`` to merge
+    serving and training series into one sink; the public recording API
+    and :meth:`summary` values are unchanged (summary still computes
+    exact percentiles over the raw samples, not histogram buckets).
+
+    Per-request transient state (``_submitted``/``_last_token``) is
+    dropped when a request reaches ANY terminal state — finished,
+    evicted, errored or timed out — so a long-running engine no longer
+    leaks an entry per request that finished without tokens.
     """
 
-    def __init__(self, clock: Callable[[], float] = time.monotonic):
+    def __init__(self, clock: Callable[[], float] = time.monotonic,
+                 registry: Optional[Any] = None):
+        from apex_tpu.observability import MetricsRegistry
+
         self.clock = clock
+        self.registry = registry if registry is not None \
+            else MetricsRegistry(clock=clock)
         self._submitted: dict = {}       # request_id -> submit time
         self._last_token: dict = {}      # request_id -> last token time
         self.ttft: dict = {}             # request_id -> seconds
@@ -157,44 +200,90 @@ class ServingMetrics:
         self.errors = 0                  # poison requests quarantined
         self.timeouts = 0                # per-request timeout expiries
         self._started: float | None = None
+        r = self.registry
+        self._c_requests = r.counter("serving_requests_total",
+                                     "requests submitted")
+        self._c_finished = r.counter(
+            "serving_finished_total", "requests reaching a terminal "
+            "state, by reason", labelnames=("reason",))
+        self._c_tokens = r.counter("serving_tokens_total",
+                                   "tokens sampled")
+        self._h_ttft = r.histogram("serving_ttft_seconds",
+                                   "submit -> first token")
+        self._h_latency = r.histogram("serving_token_latency_seconds",
+                                      "inter-token decode latency")
+        self._g_occupancy = r.gauge("serving_slot_occupancy",
+                                    "active/total slots (last step)")
+        self._g_queue = r.gauge("serving_active_requests",
+                                "requests currently admitted")
 
     def request_submitted(self, request_id) -> None:
         self._submitted[request_id] = self.clock()
         if self._started is None:
             self._started = self._submitted[request_id]
+        self._c_requests.inc()
 
     def first_token(self, request_id) -> None:
         now = self.clock()
         self.ttft[request_id] = now - self._submitted.get(request_id, now)
         self._last_token[request_id] = now
         self.tokens_emitted += 1
+        self._h_ttft.observe(self.ttft[request_id])
+        self._c_tokens.inc()
 
     def token(self, request_id) -> None:
         now = self.clock()
         prev = self._last_token.get(request_id)
         if prev is not None:
             self.token_latencies.append(now - prev)
+            self._h_latency.observe(now - prev)
         self._last_token[request_id] = now
         self.tokens_emitted += 1
+        self._c_tokens.inc()
 
     def step(self, active_slots: int, total_slots: int) -> None:
         self.occupancy.append((active_slots, total_slots))
+        self._g_occupancy.set(active_slots / total_slots
+                              if total_slots else 0.0)
+        self._g_queue.set(active_slots)
+
+    def _terminal(self, request_id, reason: str) -> None:
+        # terminal-state cleanup: without these pops a request that
+        # finished without tokens leaked its _submitted/_last_token
+        # entries for the life of the engine
+        self._submitted.pop(request_id, None)
+        self._last_token.pop(request_id, None)
+        self._c_finished.inc(reason=reason)
+
+    def request_finished(self, request_id, reason: str = "done") -> None:
+        """A request completed normally (eos / length).  Drops its
+        transient state and counts the terminal reason."""
+        self._terminal(request_id, reason)
 
     def request_evicted(self, request_id) -> None:
         """A request hit its deadline — mid-decode or still queued.
         Without this the slot simply vanished from the stats (a request
         that never reached first_token left no trace in ``summary``)."""
         self.evicted += 1
+        self._terminal(request_id, "evicted")
 
     def request_error(self, request_id) -> None:
         """A poison request was quarantined (its sampling/decode raised);
         the engine finished it with ``reason="error"`` instead of dying."""
         self.errors += 1
+        self._terminal(request_id, "error")
 
     def request_timeout(self, request_id) -> None:
         """A request exceeded its per-request ``timeout`` budget
         (distinct from absolute-``deadline`` eviction)."""
         self.timeouts += 1
+        self._terminal(request_id, "timeout")
+
+    @property
+    def pending_requests(self) -> int:
+        """Requests submitted but not yet terminal (leak sentinel:
+        returns to 0 on an idle engine)."""
+        return len(self._submitted)
 
     @staticmethod
     def _pct(xs, q):

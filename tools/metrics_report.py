@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Render an apex_tpu JSONL metrics stream as a human-readable report.
+
+The stream is whatever a :class:`~apex_tpu.observability.MetricsRegistry`
+appended — declare records, per-mutation metric events, and free-form
+records like the training monitor's per-step ``train_step`` lines or
+``bench.py``'s per-leg ``bench_leg`` results.  The report replays the
+stream into a fresh registry (exactly — declare records carry help text
+and bucket boundaries) and prints:
+
+* a per-metric table (counters/gauges: current value per label set;
+  histograms: count / mean / sum),
+* a training rollup over the ``train_step`` records (steps, mean/p50
+  step time, tokens/s, loss trajectory endpoints, anomaly count),
+* the tail of any other free-form records.
+
+Usage:
+    python tools/metrics_report.py metrics.jsonl            # report
+    python tools/metrics_report.py metrics.jsonl --prom     # Prometheus
+        text snapshot of the replayed registry instead
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from apex_tpu.observability import Histogram, replay_jsonl  # noqa: E402
+
+
+def _fmt(v):
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return str(v)
+
+
+def report(lines, out=sys.stdout):
+    reg, records = replay_jsonl(lines)
+    snap = reg.snapshot()
+    if snap:
+        out.write("== metrics ==\n")
+    for name in sorted(snap):
+        m = reg.get(name)
+        info = snap[name]
+        for key, val in sorted(info["series"].items()):
+            labels = ",".join(f"{n}={v}" for n, v in
+                              zip(info["labelnames"], key))
+            label_s = f"{{{labels}}}" if labels else ""
+            if isinstance(m, Histogram):
+                mean = val["sum"] / val["count"] if val["count"] else 0.0
+                out.write(f"{name}{label_s}  count={val['count']} "
+                          f"mean={_fmt(mean)} sum={_fmt(val['sum'])}\n")
+            else:
+                out.write(f"{name}{label_s}  {_fmt(val)}\n")
+
+    steps = [r for r in records if r.get("event") == "train_step"]
+    if steps:
+        times = sorted(r["step_time_s"] for r in steps
+                       if "step_time_s" in r)
+        losses = [r["loss"] for r in steps if "loss" in r]
+        anomalies = max((r.get("anomalies", 0) for r in steps), default=0)
+        out.write("\n== training ==\n")
+        out.write(f"steps: {len(steps)}\n")
+        if times:
+            mean = sum(times) / len(times)
+            out.write(f"step_time_s: mean={_fmt(mean)} "
+                      f"p50={_fmt(times[len(times) // 2])} "
+                      f"max={_fmt(times[-1])}\n")
+            last = next((r for r in reversed(steps)
+                         if "tokens_per_s" in r), None)
+            if last is not None:
+                out.write(f"tokens_per_s (last): "
+                          f"{_fmt(last['tokens_per_s'])}\n")
+        if losses:
+            out.write(f"loss: first={_fmt(losses[0])} "
+                      f"last={_fmt(losses[-1])}\n")
+        out.write(f"anomalies: {anomalies}\n")
+
+    other = [r for r in records if r.get("event") != "train_step"]
+    if other:
+        out.write("\n== events ==\n")
+        for r in other[-20:]:
+            kind = r.get("event", "?")
+            rest = {k: v for k, v in r.items() if k not in ("event", "ts")}
+            out.write(f"{kind}: {rest}\n")
+    return reg
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("stream", help="JSONL metrics stream file")
+    ap.add_argument("--prom", action="store_true",
+                    help="print a Prometheus text snapshot instead")
+    args = ap.parse_args(argv)
+    with open(args.stream, encoding="utf-8") as f:
+        lines = f.readlines()
+    if args.prom:
+        reg, _ = replay_jsonl(lines)
+        sys.stdout.write(reg.prometheus())
+    else:
+        report(lines)
+
+
+if __name__ == "__main__":
+    main()
