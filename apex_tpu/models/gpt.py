@@ -789,7 +789,8 @@ def _is_sharded(spec) -> bool:
 def pack_for_shard_map(model: GPTModel, params, n_stages: Optional[int] = None,
                        tensor_axis: Optional[str] = "model",
                        pipe_axis: str = "pipe",
-                       expert_axis: Optional[str] = None):
+                       expert_axis: Optional[str] = None,
+                       n_virtual: int = 1):
     """Pack serial-init GPT params for an explicit ``shard_map`` step.
 
     TP-sharded leaves (per :meth:`GPTModel.partition_specs`) are stacked
@@ -802,11 +803,13 @@ def pack_for_shard_map(model: GPTModel, params, n_stages: Optional[int] = None,
     over the pipe axis (:func:`stack_layers_for_pipeline`).  With
     ``expert_axis`` (MoE models) the expert stacks (``mlp.w1``/``w2``)
     additionally split their EXPERT dim over that axis — leading mesh
-    axes are ordered ``(tp, expert, pipe)``.
+    axes are ordered ``(tp, expert, pipe)``.  ``n_virtual > 1`` keeps an
+    extra per-device ``(n_virtual,)`` chunk axis on the layer leaves for
+    the interleaved schedule (see :func:`stack_layers_for_pipeline`).
 
     Returns ``(packed, in_specs, local_fn, repack_fn)``:
     ``local_fn`` strips the unit mesh axes inside ``shard_map`` to yield
-    the per-device params :class:`GPTModel`/:func:`pipeline_loss` consume;
+    the per-device params :class:`GPTModel`/:func:`pipeline_step` consume;
     ``repack_fn`` is its inverse for gradient pytrees (so ``out_specs`` can
     reuse ``in_specs``).
     """
@@ -820,7 +823,10 @@ def pack_for_shard_map(model: GPTModel, params, n_stages: Optional[int] = None,
     shards = [shard_params_for_tp(cfg, params, r) for r in range(n_tp)]
     if n_stages is not None:
         for sh in shards:
-            sh["layers"] = stack_layers_for_pipeline(sh["layers"], n_stages)
+            sh["layers"] = stack_layers_for_pipeline(sh["layers"], n_stages,
+                                                     n_virtual)
+    elif n_virtual != 1:
+        raise ValueError("n_virtual requires n_stages")
     specs = model.partition_specs()
     if n_stages is not None:
         specs = dict(specs, layers=specs["layers"][0])
@@ -856,7 +862,7 @@ def pack_for_shard_map(model: GPTModel, params, n_stages: Optional[int] = None,
         def expert_split(s, x, lay, exp):
             if not exp:
                 return x
-            e_pos = 3 if lay else 1
+            e_pos = (3 + (n_virtual > 1)) if lay else 1
             nl = x.shape[e_pos] // ep
             x = x.reshape(x.shape[:e_pos] + (ep, nl) + x.shape[e_pos + 1:])
             return jnp.moveaxis(x, e_pos, 1)
@@ -893,8 +899,9 @@ def pack_for_shard_map(model: GPTModel, params, n_stages: Optional[int] = None,
 
 # -- pipeline composition ----------------------------------------------------
 
-def stack_layers_for_pipeline(layer_params, n_stages: int):
-    """Split per-layer params into ``n_stages`` contiguous stage stacks.
+def stack_layers_for_pipeline(layer_params, n_stages: int,
+                              n_virtual: int = 1):
+    """Split per-layer params into pipeline stage stacks.
 
     ``layer_params`` is the ``params["layers"]`` list; returns a pytree
     whose leaves have shape ``(n_stages, layers_per_stage, ...)`` — shard
@@ -902,48 +909,93 @@ def stack_layers_for_pipeline(layer_params, n_stages: int):
     ``P("pipe", ...)``), drop the unit axis inside ``shard_map``, and each
     stage holds exactly its contiguous block of layers (apex: layer ranges
     assigned per pipeline rank).
+
+    With ``n_virtual > 1`` (interleaved schedule) the model splits into
+    ``n_stages * n_virtual`` logical stages and leaves come back as
+    ``(n_stages, n_virtual, layers_per_stage, ...)`` with device ``s``
+    chunk ``c`` holding logical stage ``c * n_stages + s`` (Megatron's
+    interleaved chunk assignment).
     """
     n_layers = len(layer_params)
-    if n_layers % n_stages:
+    n_logical = n_stages * n_virtual
+    if n_layers % n_logical:
         raise ValueError(
             f"num_layers ({n_layers}) must be divisible by the number of "
-            f"pipeline stages ({n_stages})")
+            f"logical pipeline stages ({n_stages} x {n_virtual})")
     stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
                                      *layer_params)
+    lpc = n_layers // n_logical
+    if n_virtual == 1:
+        return jax.tree_util.tree_map(
+            lambda x: x.reshape((n_stages, lpc) + x.shape[1:]), stacked)
+    perm = jnp.asarray([c * n_stages + s
+                        for s in range(n_stages) for c in range(n_virtual)])
     return jax.tree_util.tree_map(
-        lambda x: x.reshape((n_stages, n_layers // n_stages) + x.shape[1:]),
+        lambda x: x.reshape((n_logical, lpc) + x.shape[1:])[perm].reshape(
+            (n_stages, n_virtual, lpc) + x.shape[1:]),
         stacked)
 
 
-def make_stage_fn(model: GPTModel, with_dropout_seed: bool = False):
-    """Build the pipeline ``stage_fn``: scan this stage's stacked layer
-    params over the activation (``(mb, s, h) -> (mb, s, h)``).
+def make_stage_fn(model: GPTModel, dropout_seed=None,
+                  remat: Optional[bool] = None):
+    """Build the ring-engine ``stage_fn``: scan this chunk's stacked layer
+    params over the activation (``(mb, s, h) -> (mb, s, h)``), signature
+    ``stage_fn(stage_params, x, info)`` (see
+    :class:`~apex_tpu.transformer.pipeline_parallel.JobInfo`).
 
-    The stage activation is ``x`` or a tuple riding extra scalars on the
-    pipeline carry (ppermuted stage-to-stage with the activation):
+    The stage activation is ``x`` or, for MoE models, ``(x, aux)`` —
+    each logical stage adds its local layers' Switch aux contributions so
+    the last stage holds the per-microbatch total; the tuple rides the
+    ppermute ring (and its cotangent the backward ring) like any leaf.
 
-    * MoE models: ``aux`` — each stage adds its local layers' Switch aux
-      contributions, so the last stage holds the per-microbatch total.
-    * ``with_dropout_seed``: ``seed`` — the attention-dropout stream,
-      advanced by ``_SEED_LAYER_STRIDE`` per layer as it rides the carry,
-      matching the serial backbone's ``base + i * stride`` walk with no
-      stage/virtual-chunk index arithmetic.
+    ``dropout_seed`` enables attention dropout: the per-layer stream is
+    derived *arithmetically* from the job identity — layer ``j`` of
+    logical stage ``info.stage`` on microbatch ``info.microbatch`` draws
+    ``base + m*MB_STRIDE + (stage*lpc + j)*LAYER_STRIDE`` (int32,
+    wrapping) — so seeds never ride the ring and the backward recompute
+    replays the exact forward masks.
 
-    Tuple order: ``(x[, aux][, seed])``.
+    ``remat`` (default ``cfg.remat``) wraps each layer in
+    ``jax.checkpoint`` with the configured policy: inside the engine's
+    per-tick vjp this bounds the *within-job* residuals to layer
+    boundaries (the schedule itself already recomputes the stage forward
+    from the saved stage input).
     """
     layer = model.layers[0]       # all layers share the module config
     moe = model.cfg.n_experts > 0
+    if remat is None:
+        remat = model.cfg.remat
+    call = layer
+    if remat:
+        call = jax.checkpoint(
+            lambda lp, h, c, s, sd, _l=layer: _l(lp, h, c, s, sd),
+            policy=_remat_policy(model.cfg.remat_policy))
 
-    def stage_fn(stage_params, carry):
-        parts = list(carry) if isinstance(carry, tuple) else [carry]
-        x = parts[0]
-        aux = parts[1] if moe else None
-        seed = parts[-1] if with_dropout_seed else None
-        cos, sin = model.rope_tables(x.shape[1])
+    def stage_fn(stage_params, carry, info):
+        if moe:
+            x, aux = carry
+        else:
+            x, aux = carry, None
+        # under SP the carry is sequence-scattered (mb, s/t, h) but rope
+        # positions are global: tables span the FULL sequence (the
+        # attention block gathers to full seq internally), mirroring
+        # __call__'s ``backbone(..., seq_len=tokens.shape[1])``
+        seq = x.shape[1]
+        if model._sp_enabled():
+            seq = seq * model.cfg.tensor_parallel_size
+        cos, sin = model.rope_tables(seq)
+        seed = None
+        if dropout_seed is not None:
+            lpc = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
+            seed = (jnp.asarray(dropout_seed, jnp.int32)
+                    + jnp.asarray(info.microbatch, jnp.int32)
+                    * jnp.int32(_SEED_MB_STRIDE)
+                    + jnp.asarray(info.stage, jnp.int32) * jnp.int32(lpc)
+                    * jnp.int32(_SEED_LAYER_STRIDE))
 
         def body(c, lp):
             h, a, sd = c
-            out = layer(lp, h, cos, sin, sd)
+            out = call(lp, h, cos, sin, sd)
             if moe:
                 y, la = out
                 a = a + la.astype(a.dtype)
@@ -952,105 +1004,123 @@ def make_stage_fn(model: GPTModel, with_dropout_seed: bool = False):
             return (y, a,
                     None if sd is None else sd + _SEED_LAYER_STRIDE), None
 
-        (y, a, sd), _ = jax.lax.scan(body, (x, aux, seed), stage_params)
-        outs = [y] + ([a] if moe else []) + ([sd] if with_dropout_seed
-                                             else [])
-        return tuple(outs) if len(outs) > 1 else outs[0]
+        (y, a, _), _ = jax.lax.scan(body, (x, aux, seed), stage_params)
+        return (y, a) if moe else y
 
     return stage_fn
 
 
-def pipeline_loss(model: GPTModel, params, tokens, targets, *,
+def pipeline_step(model: GPTModel, params, tokens, targets, *,
                   pipe_axis: str = "pipe", data_axis: Optional[str] = None,
                   n_virtual: int = 1, remat: Optional[bool] = None,
                   dropout_seed=None):
-    """GPT training loss over the SPMD pipeline — call inside ``shard_map``.
+    """GPT training step (loss AND grads) over the ring pipeline engine —
+    call inside ``shard_map``.  Returns ``(loss, grads)`` with ``grads``
+    matching ``params`` leaf-for-leaf.
 
-    ``params["layers"]`` holds this stage's stacked layers (leaves
-    ``(layers_per_stage, ...)`` from :func:`stack_layers_for_pipeline`);
-    embedding/final-LN params are replicated over the pipe axis.  ``tokens``
-    / ``targets`` are ``(M, mb, s)`` local microbatches.  Embedding and the
-    tied head run on every stage (SPMD), but only stage 0's embedding
-    output is injected into the pipeline and only the last stage's head
-    loss survives the mask, so the auto-psum of replicated-param grads over
-    the pipe axis yields exactly the apex first/last-rank gradients.
+    ``params["layers"]`` holds this device's stacked layers (leaves
+    ``(layers_per_stage, ...)``, or ``(n_virtual, layers_per_stage, ...)``
+    for the interleaved schedule, from :func:`stack_layers_for_pipeline`);
+    embedding/final-LN params are replicated over the pipe axis.
+    ``tokens``/``targets`` are ``(M, mb, s)`` local microbatches.
+
+    Gradients are hand-rolled around
+    :func:`~apex_tpu.transformer.pipeline_parallel.pipeline_schedule_step`
+    rather than taken with ``jax.grad`` over the whole step — on the jax
+    0.4.x span, differentiating through ``shard_map`` collectives is
+    version-blocked (psum-transpose cotangent scaling, partial grads for
+    replicated leaves).  The embedding runs once outside the scan under
+    its own ``jax.vjp`` (flattened over microbatches — the lookup is
+    per-token, so this is bitwise-identical to per-microbatch embeds) and
+    its pullback consumes the engine's psum-reduced ``dx0``; the tied
+    embedding weight's gradient is the sum of that pullback and the last
+    stage's head contribution.  All cross-device combining is
+    forward-mode psum/pmean of one-nonzero-plus-zeros or of identical
+    replicas, so pp=1 runs of this same function are the bitwise f32
+    reference for any (S, n_virtual).
+
+    Composition: TP requires ``sequence_parallel=True`` (the Megatron SP
+    mappings carry custom-VJP psum rules that fully reduce
+    replicated-leaf grads *inside* the local vjp; the non-SP TP path
+    relies on shard_map's auto-psum, which this engine never crosses).
+    ``data_axis`` pmeans loss+grads; an MoE ``expert_axis`` composes via
+    the :func:`~apex_tpu.transformer.expert_parallel.reduce_moe_grads`
+    recipe (dense pmean, expert leaves divided by the axis size).
     """
-    from apex_tpu.transformer.pipeline_parallel.spmd import (
-        spmd_pipeline, last_stage_mean_loss)
+    from apex_tpu.transformer.pipeline_parallel.ring import (
+        pipeline_schedule_step)
 
-    # Mark every param leaf device-varying over the pipe (and data) axes:
-    # pcast's transpose is a psum over the added axes, so grads of
-    # pipe-replicated leaves come back fully reduced and invariant — which
-    # also keeps the grad vma statically exact for shard_map's out_specs
-    # (the stage-masked loss otherwise defeats the auto-psum inference).
-    # The TP axis must NOT be added: the Megatron mappings' custom_vjp
-    # rules are written against the model-invariant contract (psum outputs
-    # stay invariant), and promoting replicated params to model-varying
-    # inserts implicit pcasts whose transposes double-reduce the custom
-    # rules' cotangents.  Model-axis grad reduction is JAX's auto-psum of
-    # invariant-input grads, exactly as in the non-pipelined TP path.
-    axes = {pipe_axis}
-    if data_axis is not None:
-        axes.add(data_axis)
-    if model.cfg.expert_axis is not None:
-        # the expert axis is a batch axis for the dense compute: dense
-        # grads psum across it via the pcast transpose (see
-        # expert_parallel.vary_params_over_axis); expert-stack leaves
-        # arrive expert-varying from their sharding and are skipped
-        axes.add(model.cfg.expert_axis)
+    cfg = model.cfg
+    if cfg.axis_name is not None and not cfg.sequence_parallel:
+        raise ValueError(
+            "pipeline_step under tensor parallelism requires "
+            "sequence_parallel=True (non-SP TP grads need shard_map's "
+            "auto-psum, which the hand-rolled pipeline backward bypasses)")
+    moe = cfg.n_experts > 0
+    n_mb, mb, seq = tokens.shape
+    with_seed = (cfg.attention_dropout > 0.0 and dropout_seed is not None)
 
-    def _vary(p):
-        if not hasattr(jax, "typeof"):  # pre-vma JAX: implicitly varying
-            return p
-        missing = tuple(axes - set(jax.typeof(p).vma))
-        return jax.lax.pcast(p, missing, to="varying") if missing else p
+    # ---- embedding: one flattened-batch vjp outside the scan ----------
+    embed_keys = ["embedding"] + ([] if cfg.rotary
+                                  else ["position_embedding"])
+    embed_params = {k: params[k] for k in embed_keys}
 
-    params = jax.tree_util.tree_map(_vary, params)
+    def embed_fn(ep):
+        x = model.embed(ep, tokens.reshape(n_mb * mb, seq))
+        if model._sp_enabled():
+            x = model._sp_scatter(x)
+        return x.reshape((n_mb, mb) + x.shape[1:])
 
-    moe = model.cfg.n_experts > 0
-    with_seed = (model.cfg.attention_dropout > 0.0
-                 and dropout_seed is not None)
-    x = _vary(jax.vmap(lambda t: model.embed(params, t))(tokens))
-    parts = [x]
-    if moe:
-        # aux rides the pipeline with the activation (one scalar per
-        # microbatch, starting at 0 on entry to stage 0)
-        parts.append(_vary(jnp.zeros((tokens.shape[0],), _f32)))
-    if with_seed:
-        # per-microbatch base seeds strided by _SEED_MB_STRIDE; the stage
-        # scan strides by _SEED_LAYER_STRIDE per layer, so microbatch m's
-        # layer i draws stream base + m*MB + i*LAYER — distinct from
-        # every other (m, i) pair AND from every small per-step advance
-        # of the base seed
-        M = tokens.shape[0]
-        parts.append(_vary(jnp.asarray(dropout_seed, jnp.int32)
-                           + jnp.arange(M, dtype=jnp.int32)
-                           * jnp.int32(_SEED_MB_STRIDE)))
-    x = tuple(parts) if len(parts) > 1 else x
-    # remat defaults to the model config (a cfg.remat=True model must not
-    # silently lose rematerialization under the pipeline engine), and the
-    # selective policy composes with the stage checkpoint
-    if remat is None:
-        remat = model.cfg.remat
-    outs = spmd_pipeline(make_stage_fn(model, with_dropout_seed=with_seed),
-                         params["layers"], x, axis_name=pipe_axis,
-                         n_virtual=n_virtual, remat=remat,
-                         remat_policy=_remat_policy(model.cfg.remat_policy))
+    x, embed_pull = jax.vjp(embed_fn, embed_params)
+    x0 = (x, jnp.zeros((n_mb,), _f32)) if moe else x
 
-    def head(y, t):
-        if isinstance(y, tuple):
-            aux = y[1] if moe else None
-            y = y[0]
-        mean = jnp.mean(model.head_loss(params, y, t))
+    # ---- last stage: final LN + tied vocab-parallel head + CE ---------
+    last_params = {"final_layernorm": params["final_layernorm"],
+                   "embedding": params["embedding"]}
+
+    def last_fn(lp, y, tgt, info):
+        aux = None
         if moe:
-            mean = mean + model.cfg.moe_aux_weight * aux \
-                / model.cfg.num_layers
-        return mean
+            y, aux = y
+        if model._sp_enabled():
+            y = model._sp_gather(y)
+        lm = jnp.mean(model.head_loss(lp, y, tgt))
+        if moe:
+            lm = lm + cfg.moe_aux_weight * aux / cfg.num_layers
+        return lm
 
-    loss = last_stage_mean_loss(head, outs, targets, pipe_axis)
+    loss, layer_grads, last_grads, dx0 = pipeline_schedule_step(
+        make_stage_fn(model, dropout_seed if with_seed else None,
+                      remat=remat),
+        last_fn, params["layers"], last_params, x0, targets,
+        axis_name=pipe_axis, n_virtual=n_virtual)
+
+    # ---- embedding pullback (dx0 is psum-reduced and replicated over
+    # the pipe axis, so every device computes the same grads) -----------
+    dx = dx0[0] if moe else dx0      # the aux input is a constant zero
+    (embed_grads,) = embed_pull(dx)
+    grads = dict(embed_grads)
+    grads["embedding"] = jax.tree_util.tree_map(
+        jnp.add, grads["embedding"], last_grads["embedding"])
+    grads["final_layernorm"] = last_grads["final_layernorm"]
+    grads["layers"] = layer_grads
+
     if data_axis is not None:
         loss = jax.lax.pmean(loss, data_axis)
-    if moe and model.cfg.expert_axis is not None:
-        # the expert axis doubles as a batch axis for the dense compute
-        loss = jax.lax.pmean(loss, model.cfg.expert_axis)
-    return loss
+        grads = jax.lax.pmean(grads, data_axis)
+    if moe and cfg.expert_axis is not None:
+        # the expert axis doubles as a batch axis for the dense compute:
+        # dense leaves pmean across it, expert-stack leaves are already
+        # per-shard sums of the global batch (divide, don't reduce) —
+        # the reduce_moe_grads recipe, applied here as forward ops
+        from apex_tpu.transformer.expert_parallel import is_gpt_expert_leaf
+        ep_n = _axis_size(cfg.expert_axis)
+
+        def red(path, g):
+            if is_gpt_expert_leaf(path):
+                return (g / ep_n).astype(g.dtype)
+            return jax.lax.pmean(g, cfg.expert_axis)
+
+        loss = jax.lax.pmean(loss, cfg.expert_axis)
+        grads = jax.tree_util.tree_map_with_path(red, grads)
+    return loss, grads

@@ -32,8 +32,13 @@ per-class default):
   ``(rows, 128)`` buckets and each step is one Pallas kernel sweep per
   bucket.  This is the layout the ZeRO/distributed optimizers REQUIRE —
   the packed rows are what reduce-scatter/all-gather shard evenly — so
-  it stays THEIR default; requesting it explicitly on a plain optimizer
-  warns about the measured single-chip regression.
+  it stays THEIR default.  It is no longer a public opt-in on plain
+  optimizers: two rounds of measurement (BENCH_r05
+  ``packed_vs_optax_speedup = 0.49–0.53``) found no single-chip regime
+  where it wins, so requesting it explicitly on a plain optimizer now
+  raises.  The engine itself survives as the distributed optimizers'
+  sharding unit (and the parity tests flip ``opt.bucketed`` by
+  attribute to keep pinning the kernel path).
 """
 
 from __future__ import annotations
@@ -89,16 +94,15 @@ class FusedOptimizer:
         if bucketed is None:
             bucketed = self._default_bucketed
         elif bucketed and not self._default_bucketed:
-            import warnings
-            warnings.warn(
-                "bucketed=True (packed multi_tensor layout) measured ~2x "
-                "slower than the per-leaf default for single-chip steps "
-                "(bench.py fused_adam_vs_optax: packed_vs_optax_speedup="
-                "0.531) — the pack/unpack HBM round trip outweighs the "
-                "launch savings on TPU.  Prefer the per-leaf default; the "
-                "packed layout is the distributed (ZeRO) optimizers' "
-                "sharding unit and remains their default.",
-                stacklevel=2)
+            raise ValueError(
+                "bucketed=True (packed multi_tensor layout) is not "
+                "supported on plain optimizers: it measured ~2x slower "
+                "than the per-leaf default for single-chip steps across "
+                "two bench rounds (packed_vs_optax_speedup=0.49-0.53) — "
+                "the pack/unpack HBM round trip outweighs the launch "
+                "savings on TPU.  Use the per-leaf default; the packed "
+                "layout remains the distributed (ZeRO) optimizers' "
+                "internal sharding unit.")
         self.bucketed = bool(bucketed)
         # apex semantics: cap each packed bucket at ``message_size`` BYTES
         # (dtype-aware — the cap bounds the flattened collective payload,

@@ -248,8 +248,7 @@ def vary_params_over_axis(params, axis_name: str):
     cotangents and leave them axis-varying with no way for JAX to insert
     the reduction.  ``pcast``-ing the params varying BEFORE the compute
     moves the reduction into pcast's transpose — a psum over the added
-    axis — uniformly for every leaf (the same mechanism
-    ``pipeline_loss`` uses for the pipe/data axes).  Do NOT use this on
+    axis — uniformly for every leaf.  Do NOT use this on
     the TENSOR axis: the Megatron mappings' custom_vjp rules already own
     model-axis grad reduction and would double-reduce.
     """

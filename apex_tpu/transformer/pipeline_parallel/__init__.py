@@ -1,21 +1,29 @@
+from apex_tpu.transformer.pipeline_parallel.ring import (
+    JobInfo,
+    bubble_fraction,
+    pipeline_forward,
+    pipeline_schedule_step,
+    pipeline_value_and_grad,
+    schedule_ticks,
+)
 from apex_tpu.transformer.pipeline_parallel.schedules import (
     get_forward_backward_func,
     forward_backward_no_pipelining,
     forward_backward_pipelining_without_interleaving,
     forward_backward_pipelining_with_interleaving,
 )
-from apex_tpu.transformer.pipeline_parallel.spmd import (
-    spmd_pipeline,
-    pipeline_value_and_grad,
-)
 from apex_tpu.transformer.pipeline_parallel import p2p_communication
 
 __all__ = [
+    "JobInfo",
+    "bubble_fraction",
     "get_forward_backward_func",
     "forward_backward_no_pipelining",
     "forward_backward_pipelining_without_interleaving",
     "forward_backward_pipelining_with_interleaving",
-    "spmd_pipeline",
+    "pipeline_forward",
+    "pipeline_schedule_step",
     "pipeline_value_and_grad",
+    "schedule_ticks",
     "p2p_communication",
 ]

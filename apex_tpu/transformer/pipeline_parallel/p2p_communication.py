@@ -6,34 +6,75 @@ Apex moves activations between pipeline ranks with NCCL
 a stage hop is ``lax.ppermute`` over the ``pipe`` mesh axis — compiled to a
 collective-permute riding ICI neighbors — and shapes are static under jit so
 there is no handshake.  These helpers are the explicit building blocks; the
-scan-based engine in ``spmd.py`` is what the schedules actually use.
+scan-based engine in ``ring.py`` is what the schedules actually use.
+
+Every hop is a ``custom_vjp`` primitive: the transpose of a forward
+activation hop is the same masked permute run in the opposite direction, so
+cotangents ride a counter-rotating ring instead of whatever jax's ppermute
+transpose rule produces (which is version-dependent and, on the jax
+0.4.x-era psum-transpose path, wrong inside ``shard_map``).  The engine
+never differentiates *through* these hops — it moves cotangents as plain
+data — but user code composing the half-ops under ``jax.grad`` gets correct
+rings for free.
 
 All functions must run inside ``shard_map`` with the pipe axis in scope.
-The boundary stages receive zeros (a ring permute wraps; the extra wrap
-value is masked here to match apex's "first stage receives nothing").
+With ``wrap=False`` (default) the boundary stages receive zeros (a ring
+permute wraps; the extra wrap value is masked to match apex's "first stage
+receives nothing"); ``wrap=True`` keeps the wrap value, which the
+interleaved schedule uses to hand a microbatch to the next virtual chunk.
+All helpers are pytree-aware.
 """
 
 from __future__ import annotations
+
+import functools
 
 import jax
 import jax.numpy as jnp
 
 from apex_tpu.transformer.parallel_state import PIPELINE_AXIS
-from apex_tpu.transformer.pipeline_parallel.spmd import _ring_perm
 from apex_tpu.utils.collectives import ensure_varying
 from apex_tpu.utils.collectives import axis_size as _axis_size
 
 
-def _shift(x, axis_name, forward: bool, wrap: bool):
+def _ring_perm(n):
+    """Forward ring: stage ``i`` sends to ``i + 1`` (mod n)."""
+    return [(i, (i + 1) % n) for i in range(n)]
+
+
+def _shift_impl(x, axis_name, forward: bool, wrap: bool):
     n = _axis_size(axis_name)
     perm = _ring_perm(n) if forward else [(d, s) for s, d in _ring_perm(n)]
     x = ensure_varying(x, axis_name)
-    out = jax.lax.ppermute(x, axis_name, perm)
+    out = jax.tree_util.tree_map(
+        lambda v: jax.lax.ppermute(v, axis_name, perm), x)
     if not wrap:
         s = jax.lax.axis_index(axis_name)
         edge = (s == 0) if forward else (s == n - 1)
-        out = jnp.where(edge, jnp.zeros_like(out), out)
+        out = jax.tree_util.tree_map(
+            lambda v: jnp.where(edge, jnp.zeros_like(v), v), out)
     return out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _shift(x, axis_name, forward: bool, wrap: bool):
+    return _shift_impl(x, axis_name, forward, wrap)
+
+
+def _shift_fwd(x, axis_name, forward, wrap):
+    return _shift_impl(x, axis_name, forward, wrap), None
+
+
+def _shift_bwd(axis_name, forward, wrap, _res, ct):
+    # Transpose of (edge-mask ∘ permute) is (permute⁻¹ ∘ edge-mask), which
+    # equals the opposite-direction masked shift: the value masked at the
+    # receive edge going one way is the value masked at the send edge coming
+    # back.  With wrap=True the permute is a bijection and the transpose is
+    # exactly the inverse permute.
+    return (_shift_impl(ct, axis_name, not forward, wrap),)
+
+
+_shift.defvjp(_shift_fwd, _shift_bwd)
 
 
 def send_forward_recv_forward(output_tensor, *,
@@ -42,7 +83,7 @@ def send_forward_recv_forward(output_tensor, *,
     """Send to the next stage, receive from the previous (one hop).  In an
     SPMD program send and recv are the same permute; this single primitive
     backs apex's ``send_forward``/``recv_forward`` pair."""
-    return _shift(output_tensor, axis_name, forward=True, wrap=wrap)
+    return _shift(output_tensor, axis_name, True, wrap)
 
 
 def send_backward_recv_backward(input_tensor_grad, *,
@@ -50,7 +91,7 @@ def send_backward_recv_backward(input_tensor_grad, *,
                                 wrap: bool = False):
     """Gradient hop toward earlier stages (apex ``send_backward`` /
     ``recv_backward``)."""
-    return _shift(input_tensor_grad, axis_name, forward=False, wrap=wrap)
+    return _shift(input_tensor_grad, axis_name, False, wrap)
 
 
 # apex's four half-ops map onto the two fused permutes above; aliases keep

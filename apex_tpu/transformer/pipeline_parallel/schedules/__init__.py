@@ -8,15 +8,17 @@ otherwise.  All schedule functions share the functional signature::
     fwd_bwd_func(stage_fn, loss_fn, params, microbatches, targets,
                  forward_only=False, **kw) -> (mean_loss, grads | None)
 
-run inside ``shard_map`` over the ``pipe`` (and optionally other) axes.
-The scan+ppermute engine (``spmd.py``) provides the actual pipelining; the
-1F1B and interleaved entry points differ in chunk placement (``n_virtual``),
-matching apex's schedule split, while the fine-grained backward interleaving
-apex hand-codes is delegated to XLA's scheduler.
+with ``stage_fn(params, x) -> y`` and ``loss_fn(y, target) -> scalar``.
+The pipelined schedules run inside ``shard_map`` over the ``pipe`` axis on
+the scan+ppermute engine (``ring.py``); ``forward_backward_no_pipelining``
+runs anywhere and uses the *same* accumulation order (ascending microbatch,
+loss cotangent seeded at 1/M) so it is the bitwise f32 reference for both
+pipelined schedules.
 """
 
 from __future__ import annotations
 
+import functools
 from typing import Callable, Optional
 
 import jax
@@ -27,10 +29,10 @@ from apex_tpu.transformer.parallel_state import (
     get_pipeline_model_parallel_world_size,
     get_virtual_pipeline_model_parallel_world_size,
 )
-from apex_tpu.transformer.pipeline_parallel.spmd import (
-    spmd_pipeline,
+from apex_tpu.transformer.pipeline_parallel.ring import (
+    pipeline_forward,
+    pipeline_schedule_step,
     pipeline_value_and_grad,
-    last_stage_mean_loss,
 )
 
 __all__ = [
@@ -38,46 +40,88 @@ __all__ = [
     "forward_backward_no_pipelining",
     "forward_backward_pipelining_without_interleaving",
     "forward_backward_pipelining_with_interleaving",
-    "spmd_pipeline",
+    "pipeline_forward",
     "pipeline_value_and_grad",
 ]
+
+
+def _n_microbatches(microbatches):
+    return jax.tree_util.tree_leaves(microbatches)[0].shape[0]
 
 
 def forward_backward_no_pipelining(stage_fn: Callable, loss_fn: Callable,
                                    params, microbatches, targets,
                                    forward_only: bool = False, **kw):
     """Sequential microbatches, grads accumulated; grad sync naturally
-    happens once at the end (apex: no_sync() except last microbatch)."""
-    del kw
+    happens once at the end (apex: no_sync() except last microbatch).
 
-    def loss_of(params):
-        def body(acc, mb):
-            x, t = mb
-            l = loss_fn(stage_fn(params, x), t)
-            return acc + l, l
-        total, per = jax.lax.scan(body, jnp.zeros(()),
-                                  (microbatches, targets))
-        return total / microbatches.shape[0]
+    Accumulation mirrors the ring engine exactly — per-microbatch ``vjp``
+    seeded at 1/M, summed ascending — so pipelined runs of the same model
+    match this reference bitwise in f32."""
+    del kw
+    m = _n_microbatches(microbatches)
+    inv_m = jnp.float32(1.0 / m)
 
     if forward_only:
-        return loss_of(params), None
-    return jax.value_and_grad(loss_of)(params)
+        def fbody(acc, mb):
+            x, t = mb
+            return acc + loss_fn(stage_fn(params, x), t), None
+        total, _ = jax.lax.scan(fbody, jnp.float32(0.0),
+                                (microbatches, targets))
+        return total * inv_m, None
+
+    def body(carry, mb):
+        x, t = mb
+        acc, gacc = carry
+        lm, pull = jax.vjp(lambda p: loss_fn(stage_fn(p, x), t), params)
+        (g,) = pull(inv_m)
+        gacc = jax.tree_util.tree_map(jnp.add, gacc, g)
+        return (acc + lm, gacc), None
+
+    g0 = jax.tree_util.tree_map(jnp.zeros_like, params)
+    (total, grads), _ = jax.lax.scan(body, (jnp.float32(0.0), g0),
+                                     (microbatches, targets))
+    return total * inv_m, grads
+
+
+def _adapt(stage_fn: Callable, remat: bool):
+    """Lift a plain ``stage_fn(params, x)`` to the engine's
+    ``(params, x, info)`` signature, optionally under activation remat."""
+    inner = jax.checkpoint(stage_fn) if remat else stage_fn
+    return lambda p, x, info: inner(p, x)
+
+
+def _forward_only_loss(stage_fn, loss_fn, params, microbatches, targets,
+                       axis_name, n_virtual, remat):
+    outs = pipeline_forward(_adapt(stage_fn, remat), params, microbatches,
+                            axis_name=axis_name, n_virtual=n_virtual)
+    m = _n_microbatches(microbatches)
+
+    def body(acc, mb):
+        y, t = mb
+        return acc + loss_fn(y, t), None
+
+    total, _ = jax.lax.scan(body, jnp.float32(0.0), (outs, targets))
+    return total * jnp.float32(1.0 / m)
 
 
 def forward_backward_pipelining_without_interleaving(
         stage_fn: Callable, loss_fn: Callable, params, microbatches,
         targets, forward_only: bool = False,
         axis_name: str = PIPELINE_AXIS, remat: bool = False, **kw):
-    """1F1B-equivalent SPMD pipeline (apex
-    ``forward_backward_pipelining_without_interleaving``)."""
+    """1F1B schedule (apex
+    ``forward_backward_pipelining_without_interleaving``): one model chunk
+    per pipe device, ``M + 2S − 2`` scan ticks."""
     del kw
     if forward_only:
-        outs = spmd_pipeline(stage_fn, params, microbatches,
-                             axis_name=axis_name, remat=remat)
-        return last_stage_mean_loss(loss_fn, outs, targets, axis_name), None
-    return pipeline_value_and_grad(stage_fn, loss_fn, params, microbatches,
-                                   targets, axis_name=axis_name,
-                                   n_virtual=1, remat=remat)
+        return _forward_only_loss(stage_fn, loss_fn, params, microbatches,
+                                  targets, axis_name, 1, remat), None
+    loss, grads, _, _ = pipeline_schedule_step(
+        _adapt(stage_fn, remat),
+        lambda lp, y, tgt, info: loss_fn(y, tgt),
+        params, (), microbatches, targets,
+        axis_name=axis_name, n_virtual=1)
+    return loss, grads
 
 
 def forward_backward_pipelining_with_interleaving(
@@ -87,16 +131,19 @@ def forward_backward_pipelining_with_interleaving(
         remat: bool = False, **kw):
     """Interleaved/virtual pipeline (apex
     ``_forward_backward_pipelining_with_interleaving``): params carry a
-    leading ``(n_virtual,)`` chunk axis per leaf."""
+    leading ``(n_virtual,)`` chunk axis per leaf; chunk ``c`` on device
+    ``s`` is logical stage ``c·S + s``.  Needs ``M % S == 0``."""
     del kw
     if forward_only:
-        outs = spmd_pipeline(stage_fn, params, microbatches,
-                             axis_name=axis_name, n_virtual=n_virtual,
-                             remat=remat)
-        return last_stage_mean_loss(loss_fn, outs, targets, axis_name), None
-    return pipeline_value_and_grad(stage_fn, loss_fn, params, microbatches,
-                                   targets, axis_name=axis_name,
-                                   n_virtual=n_virtual, remat=remat)
+        return _forward_only_loss(stage_fn, loss_fn, params, microbatches,
+                                  targets, axis_name, n_virtual,
+                                  remat), None
+    loss, grads, _, _ = pipeline_schedule_step(
+        _adapt(stage_fn, remat),
+        lambda lp, y, tgt, info: loss_fn(y, tgt),
+        params, (), microbatches, targets,
+        axis_name=axis_name, n_virtual=n_virtual)
+    return loss, grads
 
 
 def get_forward_backward_func(
@@ -112,7 +159,6 @@ def get_forward_backward_func(
     if pipeline_model_parallel_size > 1:
         if virtual_pipeline_model_parallel_size is not None and \
                 virtual_pipeline_model_parallel_size > 1:
-            import functools
             return functools.partial(
                 forward_backward_pipelining_with_interleaving,
                 n_virtual=virtual_pipeline_model_parallel_size)

@@ -5,8 +5,9 @@
 The model is the flagship :class:`apex_tpu.models.gpt.GPTModel` at
 hidden=4096 / layers=32 / heads=32 / seq=2048 (~6.9B params with the
 tied 50304 vocab); parallelism is the explicit shard_map form —
-``pack_for_shard_map`` + the SPMD pipeline (``pipeline_loss``) over a
-``(data, pipe, model)`` mesh — with per-layer remat and a FusedAdam
+``pack_for_shard_map`` + the ring pipeline (``pipeline_step``, 1F1B on
+a compiled scan) over a ``(data, pipe, model)`` mesh with sequence
+parallelism on the TP axis — with per-layer remat and a FusedAdam
 step, bf16 activations and fp32 params.
 
 Pod launch (v5e-64 example; the same script, no code changes):
@@ -72,11 +73,11 @@ def main():
 
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     from apex_tpu.models.gpt import (GPTConfig, GPTModel,
-                                     pack_for_shard_map, pipeline_loss)
+                                     pack_for_shard_map, pipeline_step)
+    from apex_tpu.utils.collectives import shard_map_compat as shard_map
     from apex_tpu.optimizers import FusedAdam
     from apex_tpu.transformer import parallel_state
 
@@ -97,8 +98,10 @@ def main():
     n_params = sum(int(np.prod(p.shape))
                    for p in jax.tree_util.tree_leaves(params))
 
+    # the ring pipeline's TP composition requires sequence parallelism
     par = GPTModel(GPTConfig(tensor_parallel_size=tp,
                              axis_name="model" if tp > 1 else None,
+                             sequence_parallel=tp > 1,
                              **cfg_kw))
     tensor_axis = "model" if tp > 1 else None
     packed, in_specs, local_fn, repack_fn = pack_for_shard_map(
@@ -114,9 +117,8 @@ def main():
         tk = tokens.reshape(M, mb, seq)
         tg = targets.reshape(M, mb, seq)
         # remat follows cfg.remat=True (per-layer stage checkpoint)
-        loss, g = jax.value_and_grad(
-            lambda p: pipeline_loss(par, p, tk, tg, pipe_axis="pipe",
-                                    data_axis="data"))(local_fn(sp))
+        loss, g = pipeline_step(par, local_fn(sp), tk, tg,
+                                pipe_axis="pipe", data_axis="data")
         return loss, repack_fn(g)
 
     @jax.jit

@@ -202,8 +202,9 @@ class TestAutocastO1:
         """O1 x DDP composition: autocast the per-device function, wrap
         in shard_map — collectives pass through, grads compose, and the
         interior dots run bf16."""
-        from jax import shard_map
         from jax.sharding import PartitionSpec as P
+
+        from apex_tpu.utils.collectives import shard_map_compat as shard_map
 
         mesh = jax.make_mesh((jax.device_count(),), ("data",))
         w = jnp.full((16, 16), 0.1, jnp.float32)

@@ -14,10 +14,19 @@ import pytest
 from apex_tpu.optimizers import (FusedAdam, FusedSGD, FusedLAMB,
                                  FusedNovoGrad, FusedAdagrad)
 
-# the kernel tests below request the packed engine explicitly (the ctor
-# default flipped to per-leaf after BENCH_r05 measured the packed
-# single-chip step ~2x slower); silence the advisory it emits
-pytestmark = pytest.mark.filterwarnings("ignore:bucketed=True:UserWarning")
+
+def _packed(cls, **kw):
+    """Construct with the packed multi_tensor engine.
+
+    The ctor opt-in was removed after two bench rounds measured the
+    packed single-chip step at 0.49-0.53x optax (``bucketed=True`` on a
+    plain optimizer now raises); the engine survives only as the
+    ZeRO/distributed optimizers' sharding unit.  The kernel tests below
+    still pin it directly — by attribute, the same way the distributed
+    mixin selects it."""
+    opt = cls(**kw)
+    opt.bucketed = True
+    return opt
 
 
 def make_params(rng, dtype=np.float32):
@@ -47,7 +56,7 @@ class TestFusedAdam:
     def test_matches_optax_adamw(self, rng):
         lr, wd = 1e-2, 0.05
         params = make_params(rng)
-        opt = FusedAdam(bucketed=True, lr=lr, weight_decay=wd,
+        opt = _packed(FusedAdam, lr=lr, weight_decay=wd,
                         adam_w_mode=True)
         state = opt.init(params)
         ref = optax.adamw(lr, b1=0.9, b2=0.999, eps=1e-8, weight_decay=wd)
@@ -65,7 +74,7 @@ class TestFusedAdam:
         # adam_w_mode=False folds decay into grads = optax.adam on g + wd*p
         lr, wd = 1e-2, 0.1
         params = make_params(rng)
-        opt = FusedAdam(bucketed=True, lr=lr, weight_decay=wd,
+        opt = _packed(FusedAdam, lr=lr, weight_decay=wd,
                         adam_w_mode=False)
         state = opt.init(params)
         ref = optax.adam(lr, b1=0.9, b2=0.999, eps=1e-8)
@@ -81,7 +90,7 @@ class TestFusedAdam:
 
     def test_noop_skips_step_and_count(self, rng):
         params = make_params(rng)
-        opt = FusedAdam(bucketed=True, lr=0.1)
+        opt = _packed(FusedAdam, lr=0.1)
         state = opt.init(params)
         grads = make_grads(rng, params)
         p1, s1 = opt.step(grads, params, state, noop_flag=1)
@@ -94,7 +103,7 @@ class TestFusedAdam:
 
     def test_grad_scale_fused_unscaling(self, rng):
         params = make_params(rng)
-        opt = FusedAdam(bucketed=True, lr=1e-2)
+        opt = _packed(FusedAdam, lr=1e-2)
         state = opt.init(params)
         grads = make_grads(rng, params)
         scaled = jax.tree_util.tree_map(lambda g: g * 128.0, grads)
@@ -106,7 +115,7 @@ class TestFusedAdam:
         params = make_params(rng, dtype=np.float32)
         bf16_params = jax.tree_util.tree_map(
             lambda p: p.astype(jnp.bfloat16), params)
-        opt = FusedAdam(bucketed=True, lr=1e-3, master_weights=True)
+        opt = _packed(FusedAdam, lr=1e-3, master_weights=True)
         state = opt.init(bf16_params)
         # master copies exist for the bf16 bucket
         assert any("master" in b for b in state["buckets"].values())
@@ -115,7 +124,7 @@ class TestFusedAdam:
         assert all(p.dtype == jnp.bfloat16
                    for p in jax.tree_util.tree_leaves(p1))
         # 100 tiny steps: master accumulates beyond bf16 resolution
-        fp32_opt = FusedAdam(bucketed=True, lr=1e-3)
+        fp32_opt = _packed(FusedAdam, lr=1e-3)
         fp32_state = fp32_opt.init(params)
         fp32_p = params
         for _ in range(3):
@@ -129,7 +138,7 @@ class TestFusedAdam:
         params = make_params(rng)
         no_decay = lambda path: "no_decay" if ("bias" in path or
                                                "scale" in path) else "default"
-        opt = FusedAdam(bucketed=True, lr=1e-2, weight_decay=0.5,
+        opt = _packed(FusedAdam, lr=1e-2, weight_decay=0.5,
                         param_group_fn=no_decay,
                         param_groups={"no_decay": {"weight_decay": 0.0}})
         state = opt.init(params)
@@ -145,17 +154,17 @@ class TestFusedAdam:
 
     def test_amsgrad_raises(self):
         with pytest.raises(RuntimeError):
-            FusedAdam(bucketed=True, amsgrad=True)
+            _packed(FusedAdam, amsgrad=True)
 
     def test_as_optax(self, rng):
         params = make_params(rng)
-        tx = FusedAdam(bucketed=True, lr=1e-2).as_optax()
+        tx = _packed(FusedAdam, lr=1e-2).as_optax()
         state = tx.init(params)
         grads = make_grads(rng, params)
         upd, state = tx.update(grads, state, params)
         new_p = optax.apply_updates(params, upd)
-        ref_p, _ = FusedAdam(bucketed=True, lr=1e-2).step(
-            grads, params, FusedAdam(bucketed=True, lr=1e-2).init(params))
+        ref_p, _ = _packed(FusedAdam, lr=1e-2).step(
+            grads, params, _packed(FusedAdam, lr=1e-2).init(params))
         tree_allclose(new_p, ref_p, rtol=1e-5)
 
 
@@ -163,7 +172,7 @@ class TestFusedSGD:
     def test_matches_optax_sgd_momentum(self, rng):
         lr, mu = 0.1, 0.9
         params = make_params(rng)
-        opt = FusedSGD(bucketed=True, lr=lr, momentum=mu)
+        opt = _packed(FusedSGD, lr=lr, momentum=mu)
         state = opt.init(params)
         ref = optax.sgd(lr, momentum=mu, nesterov=False)
         ref_params, ref_state = params, ref.init(params)
@@ -177,7 +186,7 @@ class TestFusedSGD:
     def test_nesterov(self, rng):
         lr, mu = 0.05, 0.9
         params = make_params(rng)
-        opt = FusedSGD(bucketed=True, lr=lr, momentum=mu, nesterov=True)
+        opt = _packed(FusedSGD, lr=lr, momentum=mu, nesterov=True)
         state = opt.init(params)
         ref = optax.sgd(lr, momentum=mu, nesterov=True)
         ref_params, ref_state = params, ref.init(params)
@@ -190,7 +199,7 @@ class TestFusedSGD:
 
     def test_weight_decay(self, rng):
         params = make_params(rng)
-        opt = FusedSGD(bucketed=True, lr=0.1, weight_decay=0.01)
+        opt = _packed(FusedSGD, lr=0.1, weight_decay=0.01)
         state = opt.init(params)
         grads = make_grads(rng, params)
         p1, _ = opt.step(grads, params, state)
@@ -226,7 +235,7 @@ class TestFusedLAMB:
     def test_matches_reference(self, rng):
         lr, wd = 1e-2, 0.01
         params = make_params(rng)
-        opt = FusedLAMB(bucketed=True, lr=lr, weight_decay=wd)
+        opt = _packed(FusedLAMB, lr=lr, weight_decay=wd)
         state = opt.init(params)
         leaves = jax.tree_util.tree_leaves(params)
         ref_p = [np.asarray(p, np.float64) for p in leaves]
@@ -245,7 +254,7 @@ class TestFusedLAMB:
 
     def test_grad_clipping_engages(self, rng):
         params = make_params(rng)
-        opt = FusedLAMB(bucketed=True, lr=1e-2, max_grad_norm=0.5)
+        opt = _packed(FusedLAMB, lr=1e-2, max_grad_norm=0.5)
         state = opt.init(params)
         big_grads = make_grads(rng, params, scale=100.0)
         p1, _ = opt.step(big_grads, params, state)
@@ -270,7 +279,7 @@ class TestFusedMixedPrecisionLamb:
         state = opt.init(bf16_params)
         assert any("master" in b for b in state["buckets"].values())
 
-        ref_opt = FusedLAMB(bucketed=True, lr=1e-2)
+        ref_opt = _packed(FusedLAMB, lr=1e-2)
         ref_state = ref_opt.init(params)
         grads = make_grads(rng, bf16_params)
         f32_grads = jax.tree_util.tree_map(
@@ -303,7 +312,7 @@ class TestFusedMixedPrecisionLamb:
 class TestFusedNovoGradAdagrad:
     def test_novograd_first_step(self, rng):
         params = make_params(rng)
-        opt = FusedNovoGrad(bucketed=True, lr=0.1, bias_correction=False,
+        opt = _packed(FusedNovoGrad, lr=0.1, bias_correction=False,
                             grad_averaging=False, weight_decay=0.0)
         state = opt.init(params)
         grads = make_grads(rng, params)
@@ -319,7 +328,7 @@ class TestFusedNovoGradAdagrad:
 
     def test_adagrad_matches_optax(self, rng):
         params = make_params(rng)
-        opt = FusedAdagrad(bucketed=True, lr=0.1, eps=1e-10)
+        opt = _packed(FusedAdagrad, lr=0.1, eps=1e-10)
         state = opt.init(params)
         ref = optax.adagrad(0.1, initial_accumulator_value=0.0, eps=1e-10)
         ref_params, ref_state = params, ref.init(params)
@@ -340,7 +349,7 @@ class TestMasterParams:
         params = make_params(rng, dtype=np.float32)
         bf16 = jax.tree_util.tree_map(
             lambda p: p.astype(jnp.bfloat16), params)
-        opt = FusedAdam(bucketed=True, lr=1e-3, master_weights=True)
+        opt = _packed(FusedAdam, lr=1e-3, master_weights=True)
         state = opt.init(bf16)
         grads = make_grads(rng, bf16)
         p, s = opt.step(grads, bf16, state)
@@ -356,7 +365,7 @@ class TestMasterParams:
         from apex_tpu import amp
 
         params = make_params(rng, dtype=np.float32)
-        opt = FusedAdam(bucketed=True, lr=1e-3)
+        opt = _packed(FusedAdam, lr=1e-3)
         state = opt.init(params)
         masters = amp.master_params(opt, params, state)
         for m, p in zip(jax.tree_util.tree_leaves(masters),
@@ -386,7 +395,7 @@ class TestPerLeafLayout:
                              ids=lambda o: getattr(o, "__name__", None))
     def test_matches_packed_trajectory(self, rng, cls, kw):
         params = make_params(rng)
-        packed = cls(bucketed=True, **kw)
+        packed = _packed(cls, **kw)
         leaf = cls(bucketed=False, **kw)
         ps, ss = params, packed.init(params)
         pl_, sl = params, leaf.init(params)
@@ -402,7 +411,7 @@ class TestPerLeafLayout:
         params32 = make_params(rng)
         bf16 = jax.tree_util.tree_map(
             lambda p: p.astype(jnp.bfloat16), params32)
-        packed = FusedLAMB(bucketed=True, lr=1e-2, master_weights=True)
+        packed = _packed(FusedLAMB, lr=1e-2, master_weights=True)
         leaf = FusedLAMB(lr=1e-2, master_weights=True, bucketed=False)
         ps, ss = bf16, packed.init(bf16)
         pl_, sl = bf16, leaf.init(bf16)
@@ -426,7 +435,7 @@ class TestPerLeafLayout:
                                  in path else "default")
         kw = dict(lr=1e-2, weight_decay=0.1, param_group_fn=group_fn,
                   param_groups={"no_decay": {"weight_decay": 0.0}})
-        packed = FusedAdam(bucketed=True, **kw)
+        packed = _packed(FusedAdam, **kw)
         leaf = FusedAdam(bucketed=False, **kw)
         ps, ss = params, packed.init(params)
         pl_, sl = params, leaf.init(params)
@@ -442,16 +451,16 @@ class TestPerLeafLayout:
             DistributedFusedAdam(lr=1e-3, world_size=2, axis_name="data",
                                  bucketed=False)
 
-    def test_default_layout_per_leaf_and_packed_warns(self):
-        """Post-BENCH_r05 defaults: plain optimizers default to the
-        per-leaf layout (packed measured ~2x slower on a single chip);
-        the ZeRO subclasses keep bucketed (their sharding unit); an
-        explicit packed request on a plain optimizer warns."""
+    def test_default_layout_per_leaf_and_packed_raises(self):
+        """Post-BENCH_r05 layouts: plain optimizers are per-leaf-only
+        (packed measured ~2x slower on a single chip, both rounds); the
+        ZeRO subclasses keep bucketed (their sharding unit); an explicit
+        packed request on a plain optimizer is rejected outright."""
         from apex_tpu.contrib.optimizers import DistributedFusedAdam
         assert FusedAdam(lr=1e-3).bucketed is False
         assert DistributedFusedAdam(lr=1e-3, world_size=2,
                                     axis_name="data").bucketed is True
-        with pytest.warns(UserWarning, match="per-leaf"):
+        with pytest.raises(ValueError, match="per-leaf"):
             FusedAdam(lr=1e-3, bucketed=True)
 
     def test_grad_scale_parity(self, rng):
@@ -461,7 +470,7 @@ class TestPerLeafLayout:
         enters the global-norm clip (the third arm catches a shared-code
         bug that drops/double-applies grad_scale in both layouts)."""
         params = make_params(rng)
-        packed = FusedLAMB(bucketed=True, lr=1e-2)
+        packed = _packed(FusedLAMB, lr=1e-2)
         leaf = FusedLAMB(lr=1e-2, bucketed=False)
         unscaled = FusedLAMB(lr=1e-2, bucketed=False)
         ps, ss = params, packed.init(params)

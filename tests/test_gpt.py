@@ -12,10 +12,11 @@ from apex_tpu.utils.collectives import shard_map_compat as shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from apex_tpu.models.gpt import (GPTConfig, GPTModel, make_stage_fn,
-                                 pack_for_shard_map, pipeline_loss,
+                                 pack_for_shard_map, pipeline_step,
                                  shard_params_for_tp,
                                  stack_layers_for_pipeline)
 from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.pipeline_parallel import JobInfo
 
 
 def tiny_cfg(**kw):
@@ -209,7 +210,7 @@ class TestGPTCombinedParallel:
             ref_grads = jax.jit(jax.grad(serial_loss))(params)
 
             cfg_p = tiny_cfg(num_layers=2, tensor_parallel_size=2,
-                             axis_name="model")
+                             axis_name="model", sequence_parallel=True)
             par = GPTModel(cfg_p)
             packed, in_specs, local_fn, repack_fn = pack_for_shard_map(
                 par, params, n_stages=2)
@@ -218,12 +219,9 @@ class TestGPTCombinedParallel:
                 # local batch (M*mb, s) -> (M, mb, s) microbatches
                 tk = tokens.reshape(M, mb, seq)
                 tg = targets.reshape(M, mb, seq)
-
-                def loss_fn(p):
-                    return pipeline_loss(par, p, tk, tg,
-                                         pipe_axis="pipe",
-                                         data_axis="data")
-                loss, g = jax.value_and_grad(loss_fn)(local_fn(sp))
+                loss, g = pipeline_step(par, local_fn(sp), tk, tg,
+                                        pipe_axis="pipe",
+                                        data_axis="data")
                 return loss, repack_fn(g)
 
             loss, grads = jax.jit(shard_map(
@@ -243,6 +241,122 @@ class TestGPTCombinedParallel:
                                            rtol=5e-4, atol=1e-5)
         finally:
             parallel_state.destroy_model_parallel()
+
+
+class TestPipelineBitwise:
+    """1F1B and interleaved schedules are bitwise-identical (f32 loss AND
+    grads) to the same model run at pp=1 — the engine replays the exact
+    per-microbatch accumulation order of the no-pipelining reference."""
+
+    def _run(self, model, params, tokens, targets, S, v):
+        packed, in_specs, local_fn, repack_fn = pack_for_shard_map(
+            model, params, n_stages=S, tensor_axis=None, n_virtual=v)
+        mesh = jax.make_mesh((S,), ("pipe",), devices=jax.devices()[:S])
+
+        def step(sp, tk, tg):
+            loss, g = pipeline_step(model, local_fn(sp), tk, tg,
+                                    pipe_axis="pipe", n_virtual=v)
+            return loss, repack_fn(g)
+
+        return jax.jit(shard_map(
+            step, mesh=mesh, in_specs=(in_specs, P(), P()),
+            out_specs=(P(), in_specs)))(packed, tokens, targets)
+
+    @staticmethod
+    def _logical_layers(gl, S, v, num_layers):
+        """Packed layer leaves -> logical (num_layers, ...) order."""
+        def f(a):
+            a = np.asarray(a)
+            k, p = 0, 1
+            while p < num_layers:      # leading dims multiply to L
+                p *= a.shape[k]
+                k += 1
+            while k < a.ndim - 1 and a.shape[k] == 1:
+                k += 1
+            a = a.reshape((S, v, -1) + a.shape[k:])
+            lpc = a.shape[2]
+            out = np.zeros((num_layers,) + a.shape[3:], a.dtype)
+            for s in range(S):
+                for c in range(v):
+                    for j in range(lpc):
+                        out[(c * S + s) * lpc + j] = a[s, c, j]
+            return out
+        return jax.tree_util.tree_map(f, gl)
+
+    @pytest.mark.parametrize("S,v", [(2, 1), (4, 1), (2, 2)])
+    def test_pp_matches_pp1_bitwise(self, rng, S, v):
+        cfg = tiny_cfg(num_layers=4)
+        model = GPTModel(cfg)
+        params = model.init_params(jax.random.PRNGKey(7))
+        M, mb, seq = 4, 2, 8
+        tokens = jnp.asarray(rng.randint(0, 32, (M, mb, seq)))
+        targets = jnp.asarray(rng.randint(0, 32, (M, mb, seq)))
+
+        loss1, g1 = self._run(model, params, tokens, targets, 1, 1)
+        loss, g = self._run(model, params, tokens, targets, S, v)
+
+        assert np.asarray(loss1).tobytes() == np.asarray(loss).tobytes()
+        a = self._logical_layers(g["layers"], S, v, 4)
+        b = self._logical_layers(g1["layers"], 1, 1, 4)
+        for x, y in zip(jax.tree_util.tree_leaves(a),
+                        jax.tree_util.tree_leaves(b)):
+            np.testing.assert_array_equal(x, y)
+        for k in ("embedding", "final_layernorm"):
+            for x, y in zip(jax.tree_util.tree_leaves(g[k]),
+                            jax.tree_util.tree_leaves(g1[k])):
+                np.testing.assert_array_equal(np.asarray(x),
+                                              np.asarray(y))
+
+    def test_dp_tp_pp_sp_composition_bitwise_in_pp(self, rng):
+        """dp=2 x tp=2 x pp=2 with sequence parallelism: the pp=2 run is
+        bitwise-identical to pp=1 on the same dp x tp submesh."""
+        cfg = tiny_cfg(num_layers=4, tensor_parallel_size=2,
+                       axis_name="model", sequence_parallel=True)
+        model = GPTModel(cfg)
+        serial = GPTModel(tiny_cfg(num_layers=4))
+        params = serial.init_params(jax.random.PRNGKey(8))
+        M, mb, seq = 2, 2, 8
+        tokens = jnp.asarray(rng.randint(0, 32, (2, M, mb, seq)))
+        targets = jnp.asarray(rng.randint(0, 32, (2, M, mb, seq)))
+
+        def run(pp):
+            packed, in_specs, local_fn, repack_fn = pack_for_shard_map(
+                model, params, n_stages=pp)
+            mesh = jax.make_mesh((2, 2, pp), ("data", "model", "pipe"),
+                                 devices=jax.devices()[:4 * pp])
+
+            def step(sp, tk, tg):
+                loss, g = pipeline_step(
+                    model, local_fn(sp), tk[0], tg[0],
+                    pipe_axis="pipe", data_axis="data", n_virtual=1)
+                return loss, repack_fn(g)
+
+            out = jax.jit(shard_map(
+                step, mesh=mesh,
+                in_specs=(in_specs, P("data"), P("data")),
+                out_specs=(P(), in_specs)))(packed, tokens, targets)
+            return out[0], out[1], in_specs
+
+        def canon(gl, specs):
+            """Merge the (S, lpc) packing dims (located via the leaf's
+            pipe-axis spec position) into one logical layer axis so
+            pp=1 and pp=2 packings compare leaf-for-leaf."""
+            sp_leaves = jax.tree_util.tree_leaves(
+                specs, is_leaf=lambda x: isinstance(x, P))
+            out = []
+            for a, sp in zip(jax.tree_util.tree_leaves(gl), sp_leaves):
+                a = np.asarray(a)
+                i = list(sp).index("pipe")
+                out.append(a.reshape(a.shape[:i] + (-1,)
+                                     + a.shape[i + 2:]))
+            return out
+
+        loss1, g1, specs1 = run(1)
+        loss2, g2, specs2 = run(2)
+        assert np.asarray(loss1).tobytes() == np.asarray(loss2).tobytes()
+        for x, y in zip(canon(g2["layers"], specs2["layers"]),
+                        canon(g1["layers"], specs1["layers"])):
+            np.testing.assert_array_equal(x, y)
 
 
 class TestStageStacking:
@@ -270,8 +384,9 @@ class TestStageStacking:
         params = model.init_params(jax.random.PRNGKey(6))
         x = jnp.asarray(rng.randn(2, 8, cfg.hidden_size).astype(np.float32))
         stacked = stack_layers_for_pipeline(params["layers"], 1)
+        info = JobInfo(jnp.int32(0), jnp.int32(0), jnp.int32(0))
         got = make_stage_fn(model)(
-            jax.tree_util.tree_map(lambda p: p[0], stacked), x)
+            jax.tree_util.tree_map(lambda p: p[0], stacked), x, info)
         ref, _ = model.backbone(params, x)
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                    rtol=1e-5, atol=1e-6)
@@ -337,9 +452,10 @@ class TestAttentionDropout:
         assert losses[-1] < losses[0], losses
 
     def test_pipeline_seed_carry(self, rng):
-        """The seed rides the pipeline carry: a 2-stage pipelined step
-        with dropout runs, is deterministic per seed, and differs from
-        the dropout-free pipeline."""
+        """Per-job dropout seeds are derived arithmetically from
+        (microbatch, stage): a 2-stage pipelined step with dropout runs,
+        is deterministic per seed, and differs from the dropout-free
+        pipeline."""
         cfg = tiny_cfg(attention_dropout=0.3, num_layers=2,
                        hidden_size=32, num_attention_heads=2,
                        max_seq_len=16)
@@ -356,9 +472,10 @@ class TestAttentionDropout:
 
         def run(seed):
             def fn(sp, tk, tg):
-                return pipeline_loss(model, local_fn(sp), tk, tg,
-                                     pipe_axis="pipe",
-                                     dropout_seed=seed)
+                loss, _ = pipeline_step(model, local_fn(sp), tk, tg,
+                                        pipe_axis="pipe",
+                                        dropout_seed=seed)
+                return loss
             return float(jax.jit(shard_map(
                 fn, mesh=mesh, in_specs=(in_specs, P(), P()),
                 out_specs=P()))(packed, tokens, targets))

@@ -128,7 +128,7 @@ class TestGPTFusedHead:
         from apex_tpu.utils.collectives import shard_map_compat as shard_map
 
         from apex_tpu.models.gpt import (GPTModel, pack_for_shard_map,
-                                         pipeline_loss)
+                                         pipeline_step)
 
         # fallback path: interpret-mode Pallas inside the pipeline's
         # shard_map trips kernel-INTERIOR vma strictness (a CPU-lane
@@ -145,9 +145,9 @@ class TestGPTFusedHead:
             m, params, n_stages=2, tensor_axis=None)
         mesh = jax.make_mesh((2,), ("pipe",), devices=jax.devices()[:2])
         loss = float(jax.jit(shard_map(
-            lambda sp, tk, tg: pipeline_loss(
+            lambda sp, tk, tg: pipeline_step(
                 m, local_fn(sp), tk.reshape(M, mb, seq),
-                tg.reshape(M, mb, seq), pipe_axis="pipe"),
+                tg.reshape(M, mb, seq), pipe_axis="pipe")[0],
             mesh=mesh, in_specs=(in_specs, P(), P()),
             out_specs=P()))(packed, tokens, tokens))
         np.testing.assert_allclose(loss, ref, rtol=1e-5)

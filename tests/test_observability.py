@@ -14,7 +14,7 @@ from apex_tpu.utils.collectives import shard_map_compat as shard_map
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu.models.gpt import (GPTConfig, GPTModel, pack_for_shard_map,
-                                 pipeline_loss)
+                                 pipeline_step)
 from apex_tpu.transformer import parallel_state
 from apex_tpu.transformer.log_util import (get_transformer_logger,
                                            set_logging_level)
@@ -125,9 +125,13 @@ class TestMemoryStats:
 
 
 class TestPipelineMemoryProfile:
-    """The round-1/2 open question: what does the scan pipeline's
-    activation residency actually do as microbatch count M grows, with
-    and without remat?  Measured via XLA's own accounting."""
+    """The round-1/2 open question, re-measured on the ring engine: the
+    scan saves only stage INPUTS in a fixed ``2L-1`` ring buffer and
+    recomputes each stage forward inside the per-tick vjp, so activation
+    residency is bounded in M — temp grows only by the ``(M, ...)``
+    microbatch I/O buffers — and ``remat`` (per-layer checkpoint inside
+    the tick vjp) cuts the within-tick residuals.  Measured via XLA's own
+    accounting."""
 
     def _pipeline_grad_temp(self, M, remat):
         parallel_state.destroy_model_parallel()
@@ -144,10 +148,8 @@ class TestPipelineMemoryProfile:
 
             def step(sp, tokens):
                 tk = tokens.reshape(M, mb, seq)
-                loss, g = jax.value_and_grad(
-                    lambda p: pipeline_loss(model, p, tk, tk,
-                                            pipe_axis="pipe",
-                                            remat=remat))(local_fn(sp))
+                loss, g = pipeline_step(model, local_fn(sp), tk, tk,
+                                        pipe_axis="pipe", remat=remat)
                 return loss, repack_fn(g)
 
             fn = shard_map(step, mesh=mesh,
@@ -158,25 +160,27 @@ class TestPipelineMemoryProfile:
         finally:
             parallel_state.destroy_model_parallel()
 
-    def test_remat_flattens_residency_growth(self):
+    def test_remat_cuts_tick_residuals_and_growth_stays_io_bound(self):
         t2_plain = self._pipeline_grad_temp(2, remat=False)
         if t2_plain is None:
             pytest.skip("backend lacks memory_analysis")
         t6_plain = self._pipeline_grad_temp(6, remat=False)
         t2_remat = self._pipeline_grad_temp(2, remat=True)
         t6_remat = self._pipeline_grad_temp(6, remat=True)
-        growth_plain = t6_plain - t2_plain
-        growth_remat = t6_remat - t2_remat
-        # saved-residual growth with M must shrink under remat (the
-        # docstring trade in spmd.py, now measured); print for the record
         print(f"\npipeline grad temp bytes: M=2 plain={t2_plain} "
               f"remat={t2_remat}; M=6 plain={t6_plain} remat={t6_remat}")
-        assert growth_remat < growth_plain, (
+        # remat shrinks the per-tick residual set at fixed M
+        assert t2_remat < t2_plain, (t2_remat, t2_plain)
+        assert t6_remat < t6_plain, (t6_remat, t6_plain)
+        # residency growth with M is the microbatch I/O term only — the
+        # saved-activation set is the fixed ring buffer, so the growth is
+        # no larger under plain than under remat (both ~= the I/O term)
+        assert (t6_plain - t2_plain) <= (t6_remat - t2_remat) * 2, (
             (t2_plain, t6_plain), (t2_remat, t6_remat))
 
     def _interleaved_grad_temp(self, M, remat):
-        from apex_tpu.transformer.pipeline_parallel.spmd import (
-            pipeline_value_and_grad)
+        from apex_tpu.transformer.pipeline_parallel.schedules import (
+            forward_backward_pipelining_with_interleaving)
 
         width, S, v, mb = 64, 2, 2, 2
         mesh = jax.make_mesh((S,), ("pipe",))
@@ -194,7 +198,7 @@ class TestPipelineMemoryProfile:
 
         def f(w, b, x, t):
             local = {"w": w[0], "b": b[0]}
-            lv, g = pipeline_value_and_grad(
+            lv, g = forward_backward_pipelining_with_interleaving(
                 stage, loss, local, x, t, axis_name="pipe",
                 n_virtual=v, remat=remat)
             return lv, jax.tree_util.tree_map(lambda g: g[None], g)
@@ -204,16 +208,13 @@ class TestPipelineMemoryProfile:
                        out_specs=(P(), {"w": P("pipe"), "b": P("pipe")}))
         return profiling.memory_stats(fn, w, b, x, t).get("temp")
 
-    def test_interleaved_remat_flattens_growth(self):
-        """Same measurement for the interleaved (virtual-chunk) schedule
-        — the round-1/2 open question covered for both engines."""
-        t2_plain = self._interleaved_grad_temp(2, remat=False)
-        if t2_plain is None:
+    def test_interleaved_residency_bounded_in_m(self):
+        """Same measurement for the interleaved (virtual-chunk) schedule:
+        the ring buffer is sized by L = S*v, not by M, so tripling M must
+        not triple the temp residency."""
+        t2 = self._interleaved_grad_temp(2, remat=False)
+        if t2 is None:
             pytest.skip("backend lacks memory_analysis")
-        t6_plain = self._interleaved_grad_temp(6, remat=False)
-        t2_remat = self._interleaved_grad_temp(2, remat=True)
-        t6_remat = self._interleaved_grad_temp(6, remat=True)
-        print(f"\ninterleaved grad temp bytes: M=2 plain={t2_plain} "
-              f"remat={t2_remat}; M=6 plain={t6_plain} remat={t6_remat}")
-        assert (t6_remat - t2_remat) < (t6_plain - t2_plain), (
-            (t2_plain, t6_plain), (t2_remat, t6_remat))
+        t6 = self._interleaved_grad_temp(6, remat=False)
+        print(f"\ninterleaved grad temp bytes: M=2 {t2}; M=6 {t6}")
+        assert t6 < 3 * t2, (t2, t6)

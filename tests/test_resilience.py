@@ -577,6 +577,79 @@ class TestKillAndResumeDP2TP2SP:
         _tree_equal(got_o, ref_o)
 
 
+class TestKillAndResumeDP2PP2:
+    """dp=2 x pp=2 ring pipeline: the checkpoint carries stage-stacked
+    params and the grad_fn is a 1F1B scan under shard_map; resume must
+    be bitwise against the uninterrupted run.  (tools/crash_matrix.py
+    sweeps the full kill-step x fault matrix for this component and the
+    tp=2 x pp=2 + SP one.)"""
+    N_STEPS = 3
+    KILL_AT = 2
+    M, MB, SEQ = 2, 2, 8
+
+    @staticmethod
+    def _gpt_batch(step):
+        r = np.random.RandomState(30_000 + step)
+        return (jnp.asarray(r.randint(0, 32, (8, 8))),
+                jnp.asarray(r.randint(0, 32, (8, 8))))
+
+    def _fresh(self, ckpt_dir, injector=None):
+        from apex_tpu.models.gpt import pipeline_step
+
+        model = GPTModel(GPTConfig(
+            vocab_size=32, hidden_size=16, num_layers=2,
+            num_attention_heads=4, max_seq_len=8))
+        init = model.init_params(jax.random.PRNGKey(7))
+        mesh = jax.make_mesh((2, 2), ("data", "pipe"))
+        packed, in_specs, local_fn, repack_fn = pack_for_shard_map(
+            model, init, n_stages=2, tensor_axis=None)
+        M, mb, seq = self.M, self.MB, self.SEQ
+
+        def body(sp, tk, tg):
+            # pipeline_step reduces loss/grads over data_axis itself
+            loss, g = pipeline_step(model, local_fn(sp),
+                                    tk.reshape(M, mb, seq),
+                                    tg.reshape(M, mb, seq),
+                                    pipe_axis="pipe", data_axis="data")
+            return loss, repack_fn(g)
+
+        grad_fn = shard_map(body, mesh=mesh,
+                            in_specs=(in_specs, P("data"), P("data")),
+                            out_specs=(P(), in_specs))
+        opt = FusedAdam(lr=1e-2)
+        mgr = CheckpointManager(str(ckpt_dir))
+        guard = GuardedTrainStep(grad_fn=grad_fn, optimizer=opt,
+                                 checkpoint=mgr, fault_injector=injector)
+        rep = NamedSharding(mesh, P())
+        packed = jax.device_put(packed, rep)
+        return (guard, packed, jax.device_put(opt.init(packed), rep),
+                jax.device_put(guard.init_state(), rep))
+
+    def test_resume_is_bitwise(self, tmp_path):
+        guard, params, opt_state, gstate = self._fresh(tmp_path / "a")
+        ref_p, ref_o, _ = _drive(guard, self.N_STEPS, params, opt_state,
+                                 gstate, self._gpt_batch)
+
+        inj = FaultInjector([Fault(step=self.KILL_AT,
+                                   kind="preempt_at_step")])
+        guard, params, opt_state, gstate = self._fresh(tmp_path / "b",
+                                                       injector=inj)
+        with pytest.raises(Preemption):
+            _drive(guard, self.N_STEPS, params, opt_state, gstate,
+                   self._gpt_batch)
+
+        guard2, params0, opt0, g0 = self._fresh(tmp_path / "b")
+        restored, step = guard2.checkpoint.restore(
+            guard2._template(params0, opt0, g0, None))
+        assert step == self.KILL_AT
+        got_p, got_o, _ = _drive(guard2, self.N_STEPS,
+                                 restored["params"], restored["opt"],
+                                 restored["guard"], self._gpt_batch,
+                                 start=int(np.asarray(restored["step"])))
+        _tree_equal(got_p, ref_p)
+        _tree_equal(got_o, ref_o)
+
+
 # -- serving-engine resilience ------------------------------------------------
 
 def _engine(**kw):

@@ -15,7 +15,7 @@ from jax.sharding import PartitionSpec as P
 from apex_tpu.transformer import parallel_state
 from apex_tpu.transformer import tensor_parallel as tp
 from apex_tpu.transformer.pipeline_parallel import (
-    spmd_pipeline, pipeline_value_and_grad,
+    pipeline_forward, pipeline_value_and_grad,
     forward_backward_no_pipelining, get_forward_backward_func)
 from apex_tpu.transformer.pipeline_parallel import p2p_communication as p2p
 from apex_tpu.transformer import (ConstantNumMicroBatches,
@@ -337,15 +337,16 @@ class TestPipeline:
 
         def f(params, x):
             local = jax.tree_util.tree_map(lambda p: p[0], params)
-            return spmd_pipeline(_stage_fn, local, x, axis_name="pipe")
+            return pipeline_forward(
+                lambda p, z, info: _stage_fn(p, z), local, x,
+                axis_name="pipe")
 
-        outs = jax.jit(shard_map(
+        # outputs come back (M, mb, width), replicated over the pipe axis
+        got = np.asarray(jax.jit(shard_map(
             f, mesh=pp_mesh,
             in_specs=({"w": P("pipe", None, None),
                        "b": P("pipe", None)}, P()),
-            out_specs=P("pipe")))(params, x)
-        # last stage's slice of the output holds the real outputs
-        got = np.asarray(outs).reshape(4, M, mb, width)[-1]
+            out_specs=P()))(params, x))
         def full(xx):
             for i in range(S):
                 xx = _stage_fn({"w": params["w"][i], "b": params["b"][i]},
@@ -383,9 +384,10 @@ class TestPipeline:
                                        rtol=1e-4, atol=1e-5)
 
     def test_interleaved_matches_serial(self, rng):
-        # 2 devices x 2 virtual chunks = 4 logical stages
+        # 2 devices x 2 virtual chunks = 4 logical stages; the
+        # interleaved schedule needs M % S == 0
         mesh = jax.make_mesh((2,), ("pipe",))
-        S, v, width, M, mb = 2, 2, 8, 3, 2
+        S, v, width, M, mb = 2, 2, 8, 4, 2
         rng2 = np.random.RandomState(7)
         params = _stack_stage_params(rng2, S * v, width)
         x = jnp.asarray(rng2.randn(M, mb, width).astype(np.float32))

@@ -22,11 +22,13 @@ cell per combination:
 Runs on the fake 8-device CPU mesh by default (same two-lane contract
 as ``tests/conftest.py``); ``APEX_TPU_ON_CHIP=1`` leaves the real
 backend in place.  ``--sp`` adds the dp=2 x tp=2 sequence-parallel GPT
-component next to the default dp=2 data-parallel one.
+component next to the default dp=2 data-parallel one; ``--pp`` adds the
+ring-pipeline components — dp=2 x pp=2 and tp=2 x pp=2 + SP — whose
+grad_fn is the 1F1B ``pipeline_step`` scan under shard_map.
 
 Usage::
 
-    python tools/crash_matrix.py [--steps 5] [--sp]
+    python tools/crash_matrix.py [--steps 5] [--sp] [--pp]
 """
 
 from __future__ import annotations
@@ -231,12 +233,106 @@ def _component_dp2tp2_sp():
     return make_parts, batch_fn
 
 
+def _component_dp2pp2():
+    from apex_tpu.models.gpt import pipeline_step
+
+    model = GPTModel(GPTConfig(vocab_size=32, hidden_size=16,
+                               num_layers=2, num_attention_heads=4,
+                               max_seq_len=8))
+    init = model.init_params(jax.random.PRNGKey(7))
+    mesh = jax.make_mesh((2, 2), ("data", "pipe"),
+                         devices=jax.devices()[:4])
+    packed, in_specs, local_fn, repack_fn = pack_for_shard_map(
+        model, init, n_stages=2, tensor_axis=None)
+    M, mb, seq = 2, 2, 8
+
+    def body(sp, tk, tg):
+        # pipeline_step reduces loss/grads over data_axis itself
+        loss, g = pipeline_step(model, local_fn(sp),
+                                tk.reshape(M, mb, seq),
+                                tg.reshape(M, mb, seq),
+                                pipe_axis="pipe", data_axis="data")
+        return loss, repack_fn(g)
+
+    grad_fn = shard_map_compat(body, mesh=mesh,
+                               in_specs=(in_specs, P("data"), P("data")),
+                               out_specs=(P(), in_specs))
+
+    def make_parts(ckpt_dir, injector):
+        opt = FusedAdam(lr=1e-2)
+        guard = GuardedTrainStep(
+            grad_fn=grad_fn, optimizer=opt, warmup_steps=1,
+            checkpoint=CheckpointManager(ckpt_dir, keep=3,
+                                         fault_injector=injector),
+            fault_injector=injector)
+        rep = NamedSharding(mesh, P())
+        p = jax.device_put(packed, rep)
+        return (guard, p, jax.device_put(opt.init(p), rep),
+                jax.device_put(guard.init_state(), rep))
+
+    def batch_fn(step):
+        r = np.random.RandomState(50_000 + step)
+        return (jnp.asarray(r.randint(0, 32, (2 * M * mb, seq))),
+                jnp.asarray(r.randint(0, 32, (2 * M * mb, seq))))
+
+    return make_parts, batch_fn
+
+
+def _component_tp2pp2_sp():
+    from apex_tpu.models.gpt import pipeline_step
+
+    kw = dict(vocab_size=32, hidden_size=16, num_layers=2,
+              num_attention_heads=4, max_seq_len=8)
+    # the ring pipeline's TP composition requires sequence parallelism
+    par = GPTModel(GPTConfig(tensor_parallel_size=2, axis_name="model",
+                             sequence_parallel=True, **kw))
+    init = GPTModel(GPTConfig(**kw)).init_params(jax.random.PRNGKey(9))
+    mesh = jax.make_mesh((2, 2), ("model", "pipe"),
+                         devices=jax.devices()[:4])
+    packed, in_specs, local_fn, repack_fn = pack_for_shard_map(
+        par, init, n_stages=2, tensor_axis="model")
+    M, mb, seq = 2, 2, 8
+
+    def body(sp, tk, tg):
+        loss, g = pipeline_step(par, local_fn(sp),
+                                tk.reshape(M, mb, seq),
+                                tg.reshape(M, mb, seq),
+                                pipe_axis="pipe")
+        return loss, repack_fn(g)
+
+    grad_fn = shard_map_compat(body, mesh=mesh,
+                               in_specs=(in_specs, P(), P()),
+                               out_specs=(P(), in_specs))
+
+    def make_parts(ckpt_dir, injector):
+        opt = FusedAdam(lr=1e-2)
+        guard = GuardedTrainStep(
+            grad_fn=grad_fn, optimizer=opt, warmup_steps=1,
+            checkpoint=CheckpointManager(ckpt_dir, keep=3,
+                                         fault_injector=injector),
+            fault_injector=injector)
+        rep = NamedSharding(mesh, P())
+        p = jax.device_put(packed, rep)
+        return (guard, p, jax.device_put(opt.init(p), rep),
+                jax.device_put(guard.init_state(), rep))
+
+    def batch_fn(step):
+        r = np.random.RandomState(50_000 + step)
+        return (jnp.asarray(r.randint(0, 32, (M * mb, seq))),
+                jnp.asarray(r.randint(0, 32, (M * mb, seq))))
+
+    return make_parts, batch_fn
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--steps", type=int, default=5,
                     help="total train steps per run (default 5)")
     ap.add_argument("--sp", action="store_true",
                     help="also sweep the dp=2 x tp=2 + SP GPT component")
+    ap.add_argument("--pp", action="store_true",
+                    help="also sweep the ring-pipeline components: "
+                         "dp=2 x pp=2 and tp=2 x pp=2 + SP")
     args = ap.parse_args(argv)
 
     n_dev = len(jax.devices())
@@ -250,6 +346,12 @@ def main(argv=None) -> int:
             print("crash_matrix: --sp needs >=4 devices — skipped")
         else:
             components.append(("dp2xtp2+sp", _component_dp2tp2_sp))
+    if args.pp:
+        if n_dev < 4:
+            print("crash_matrix: --pp needs >=4 devices — skipped")
+        else:
+            components.append(("dp2xpp2", _component_dp2pp2))
+            components.append(("tp2xpp2+sp", _component_tp2pp2_sp))
 
     faults = ["preempt", "corrupt", "nan", "inf", "spike"]
     kill_steps = range(1, args.steps)   # step 0 has no checkpoint yet
