@@ -47,6 +47,7 @@ import numpy as np
 
 from apex_tpu.inference.kv_cache import KVCache
 from apex_tpu.inference.sampling import SamplingParams, sample
+from apex_tpu.observability.request_trace import RequestTracer
 from apex_tpu.utils.platform import is_tpu_backend
 from apex_tpu.utils.profiling import ServingMetrics
 
@@ -113,7 +114,7 @@ class InferenceEngine:
                  max_seq: Optional[int] = None, cache_dtype=None,
                  clock: Callable[[], float] = time.monotonic,
                  metrics: Optional[ServingMetrics] = None,
-                 registry=None,
+                 registry=None, tracer=None,
                  min_prompt_bucket: int = 8,
                  max_queue: Optional[int] = None):
         model._check_decode_supported()
@@ -128,6 +129,11 @@ class InferenceEngine:
         # apex_tpu.observability.MetricsRegistry (one Prometheus/JSONL
         # sink for training + serving); ignored when `metrics` is given
         self.metrics = metrics or ServingMetrics(clock, registry=registry)
+        # `tracer` (an observability.Tracer) turns on per-request Chrome
+        # trace emission; the lifecycle bookkeeping itself is always on
+        # and feeds the queue-wait / decode-ticks serving series
+        self.trace = RequestTracer(clock=clock, tracer=tracer,
+                                   metrics=self.metrics)
         self._min_bucket = min_prompt_bucket
         if max_queue is not None and max_queue < 1:
             raise ValueError("max_queue must be >= 1 (or None: unbounded)")
@@ -184,6 +190,7 @@ class InferenceEngine:
                 f"{self.max_queue}); retry after step() drains it")
         self._submit_time[request.request_id] = self.clock()
         self.metrics.request_submitted(request.request_id)
+        self.trace.enqueue(request.request_id)
         self._queue.append(request)
 
     @property
@@ -226,6 +233,7 @@ class InferenceEngine:
             # must reach ServingMetrics or the engine leaks an entry
             # per request
             self.metrics.request_finished(req.request_id, reason)
+        self.trace.finish(req.request_id, reason, error=error)
         self._done.append(Response(req.request_id, list(req.prompt),
                                    generated, reason, error=error))
 
@@ -273,6 +281,7 @@ class InferenceEngine:
         while self._queue and self.cache.free_slots:
             req = self._queue.popleft()
             slot = self.cache.allocate()
+            self.trace.admit(req.request_id)
             try:
                 plen = len(req.prompt)
                 toks = np.zeros((1, self._bucket(plen)), np.int32)
@@ -287,6 +296,7 @@ class InferenceEngine:
                                       error=f"{type(e).__name__}: {e}")
                 continue
             self.metrics.first_token(req.request_id)
+            self.trace.first_token(req.request_id)
             st = _Active(req, plen, next_token=first, position=plen,
                          generated=[first])
             self._active[slot] = st
@@ -323,6 +333,7 @@ class InferenceEngine:
                              error=f"{type(e).__name__}: {e}")
                 continue
             self.metrics.token(st.request.request_id)
+            self.trace.decode_tick(st.request.request_id)
             st.generated.append(tok)
             st.next_token = tok
             st.position += 1

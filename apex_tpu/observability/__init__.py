@@ -15,8 +15,22 @@ One registry, four surfaces:
 * :mod:`~apex_tpu.observability.comms` — static per-collective byte
   accounting (:func:`collective_stats`) from compiled HLO.
 
+The MEASURED layer on top (ISSUE 7):
+
+* :mod:`~apex_tpu.observability.costmodel` — collective microbenchmark
+  probe + fitted α–β ring :class:`CostModel` (``tools/comms_probe.py``
+  is the CLI; the profile JSON feeds the auto-parallel planner);
+* :mod:`~apex_tpu.observability.request_trace` —
+  :class:`RequestTracer`, per-request lifecycle spans
+  (queue-wait/prefill/decode) in the serving engine, with TTFT/TPOT as
+  derived quantities;
+* :mod:`~apex_tpu.observability.slo` — :class:`SLOMonitor`, rolling
+  percentiles + declarative :class:`SLOTarget`\\ s + multi-window
+  burn-rate alerts.
+
 ``tools/metrics_report.py`` renders a JSONL stream into a human
-summary; ``docs/source/observability.md`` is the user guide.
+summary (``--trace`` merges it with a span trace onto one timeline);
+``docs/source/observability.md`` is the user guide.
 """
 
 from apex_tpu.observability.registry import (
@@ -37,6 +51,20 @@ from apex_tpu.observability.comms import (
     hlo_collective_stats,
     wire_bytes,
 )
+from apex_tpu.observability.costmodel import (
+    CostModel,
+    Measurement,
+    fit_cost_model,
+    load_profile,
+    probe_collectives,
+)
+from apex_tpu.observability.request_trace import RequestRecord, RequestTracer
+from apex_tpu.observability.slo import (
+    BurnWindow,
+    RollingPercentiles,
+    SLOMonitor,
+    SLOTarget,
+)
 
 __all__ = [
     "Counter",
@@ -53,4 +81,15 @@ __all__ = [
     "format_stats",
     "hlo_collective_stats",
     "wire_bytes",
+    "CostModel",
+    "Measurement",
+    "fit_cost_model",
+    "load_profile",
+    "probe_collectives",
+    "RequestRecord",
+    "RequestTracer",
+    "BurnWindow",
+    "RollingPercentiles",
+    "SLOMonitor",
+    "SLOTarget",
 ]

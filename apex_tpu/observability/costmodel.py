@@ -1,0 +1,463 @@
+"""Measured collective cost model: probe -> alpha-beta ring fits.
+
+:mod:`~apex_tpu.observability.comms` counts the BYTES a compiled program
+moves; this module predicts the TIME those bytes take on the machine we
+are actually running on.  The auto-parallel planner (ROADMAP item 1)
+searches thousands of (dp, tp, pp, SP, dtype) candidates — it cannot
+measure each one, so its quality is bounded by the fidelity of a
+measured communication profile (AMP, arXiv:2210.07297), and quantized
+collectives make the curve per-dtype (EQuARX, arXiv:2506.17615).
+
+Three pieces:
+
+* :func:`probe_collectives` — microbenchmark ``psum`` / ``all_gather``
+  / ``psum_scatter`` / ``ppermute`` across message sizes, group sizes
+  and dtypes on the current mesh (hard-sync timing: 1-element
+  device->host readback, min of rounds — ``block_until_ready`` can
+  lie through remote-device tunnels);
+* :func:`fit_cost_model` — least-squares fit of the classic ring model
+  per (op, dtype): ``t = alpha * hops(k) + beta * wire_bytes(n, k)``
+  where ``hops`` is the number of serialized ring steps and
+  ``wire_bytes`` the per-link traffic (the same factors
+  :func:`~apex_tpu.observability.comms.wire_bytes` applies) — alpha is
+  the per-hop latency, beta the inverse link bandwidth;
+* :class:`CostModel` — ``predict(op, nbytes, group_size)`` in seconds,
+  ``predict_stats`` over a ``collective_stats`` HLO accounting dict
+  (the direct input for ``tools/autotune.py``), a ``validate`` report
+  against held-out measurements, and a VERSIONED machine-profile JSON
+  (:meth:`CostModel.save` / :func:`load_profile`) so a profile taken
+  once per machine is reusable across runs — and refused when the
+  schema moved on.
+
+``tools/comms_probe.py`` is the CLI; ``__graft_entry__`` runs the
+probe+fit+validate loop on the CPU mesh as a dryrun leg (held-out
+predictions must land within 2x of measurement).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import time
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+PROFILE_VERSION = 1
+
+#: the collectives the probe measures, by their jax.lax names
+COLLECTIVE_OPS = ("psum", "all_gather", "psum_scatter", "ppermute")
+
+#: HLO instruction kind (comms.collective_stats keys) -> probe op.
+#: all_to_all has no probe arm yet; ppermute's per-link model (factor
+#: 1.0, one hop) is the closest stand-in.
+HLO_KIND_TO_OP = {
+    "all_reduce": "psum",
+    "all_gather": "all_gather",
+    "reduce_scatter": "psum_scatter",
+    "collective_permute": "ppermute",
+    "all_to_all": "ppermute",
+}
+
+_DTYPE_WIDTH = {"f32": 4, "bf16": 2, "f16": 2, "int8": 1, "i8": 1}
+
+
+def ring_hops(op: str, group_size: int) -> float:
+    """Serialized ring steps for one collective over ``group_size``
+    devices: a ring all-reduce runs ``2(k-1)`` hops (reduce-scatter
+    phase + all-gather phase), gather/scatter ``k-1``, a permute 1."""
+    k = max(int(group_size), 1)
+    if op == "psum":
+        return 2.0 * (k - 1)
+    if op in ("all_gather", "psum_scatter"):
+        return float(k - 1)
+    if op == "ppermute":
+        return 1.0
+    raise ValueError(f"unknown collective op {op!r}")
+
+
+def ring_wire_bytes(op: str, nbytes: int, group_size: int) -> float:
+    """Per-link wire traffic for ``nbytes`` of payload — the same ring
+    factors as :func:`~apex_tpu.observability.comms.wire_bytes`
+    (payload bytes use the comms accounting convention: the largest
+    shape on the instruction)."""
+    k = max(int(group_size), 1)
+    if op == "psum":
+        return nbytes * (2.0 * (k - 1) / k if k > 1 else 2.0)
+    if op in ("all_gather", "psum_scatter"):
+        return nbytes * ((k - 1) / k if k > 1 else 1.0)
+    if op == "ppermute":
+        return float(nbytes)
+    raise ValueError(f"unknown collective op {op!r}")
+
+
+@dataclasses.dataclass
+class Measurement:
+    """One probed point: ``time_s`` (min of rounds) for one execution
+    of ``op`` moving ``nbytes`` of payload over ``group_size`` devices.
+    ``nbytes`` follows the comms accounting convention so measured
+    points line up with HLO-derived byte counts."""
+    op: str
+    dtype: str
+    group_size: int
+    nbytes: int
+    time_s: float
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Measurement":
+        return cls(op=d["op"], dtype=d["dtype"],
+                   group_size=int(d["group_size"]),
+                   nbytes=int(d["nbytes"]), time_s=float(d["time_s"]))
+
+
+@dataclasses.dataclass
+class CostFit:
+    """Fitted ring coefficients for one (op, dtype) curve."""
+    alpha_s: float           # per-hop latency (startup) in seconds
+    beta_s_per_byte: float   # seconds per wire byte (1 / link bandwidth)
+    n_points: int = 0
+    max_rel_err: float = 0.0   # worst |pred/meas - 1| over the fit set
+
+    def predict(self, op: str, nbytes: int, group_size: int) -> float:
+        return (self.alpha_s * ring_hops(op, group_size)
+                + self.beta_s_per_byte
+                * ring_wire_bytes(op, nbytes, group_size))
+
+
+def _lstsq_fit(rows: List[Tuple[float, float, float]]) -> Tuple[float, float]:
+    """Least-squares ``t = alpha*h + beta*w`` with both coefficients
+    clamped non-negative (a negative latency or bandwidth is noise, and
+    extrapolating with one inverts the size ordering)."""
+    import numpy as np
+
+    A = np.asarray([[h, w] for h, w, _ in rows], dtype=np.float64)
+    t = np.asarray([y for _, _, y in rows], dtype=np.float64)
+    if len(rows) == 1:
+        # single point: attribute everything to latency
+        h, w, y = rows[0]
+        return (y / h if h else 0.0), 0.0
+    coef, *_ = np.linalg.lstsq(A, t, rcond=None)
+    alpha, beta = float(coef[0]), float(coef[1])
+    if beta < 0.0:            # latency-dominated noise: refit alpha only
+        beta = 0.0
+        hs = A[:, 0]
+        alpha = float((t * hs).sum() / (hs * hs).sum()) if hs.any() else 0.0
+    if alpha < 0.0:           # bandwidth-dominated: refit beta only
+        alpha = 0.0
+        ws = A[:, 1]
+        beta = float((t * ws).sum() / (ws * ws).sum()) if ws.any() else 0.0
+    return max(alpha, 0.0), max(beta, 0.0)
+
+
+def fit_cost_model(measurements: Iterable[Measurement],
+                   meta: Optional[dict] = None) -> "CostModel":
+    """Fit one :class:`CostFit` per (op, dtype) curve by least squares
+    over the ring design matrix ``[hops, wire_bytes]``."""
+    groups: Dict[Tuple[str, str], List[Measurement]] = {}
+    for m in measurements:
+        groups.setdefault((m.op, m.dtype), []).append(m)
+    fits: Dict[Tuple[str, str], CostFit] = {}
+    for key, ms in groups.items():
+        op = key[0]
+        rows = [(ring_hops(op, m.group_size),
+                 ring_wire_bytes(op, m.nbytes, m.group_size),
+                 m.time_s) for m in ms]
+        alpha, beta = _lstsq_fit(rows)
+        fit = CostFit(alpha_s=alpha, beta_s_per_byte=beta,
+                      n_points=len(ms))
+        errs = [abs(fit.predict(m.op, m.nbytes, m.group_size)
+                    / m.time_s - 1.0)
+                for m in ms if m.time_s > 0]
+        fit.max_rel_err = max(errs, default=0.0)
+        fits[key] = fit
+    return CostModel(fits, meta=meta)
+
+
+class CostModel:
+    """Per-(op, dtype) alpha-beta ring model with a versioned profile.
+
+    ``predict`` never raises on an unknown dtype — it falls back to the
+    op's f32 curve, then to any curve for the op (a planner asking
+    about an un-probed dtype should get the conservative wider-dtype
+    estimate, not an exception mid-search) — but an unknown OP raises:
+    silently guessing a collective's algorithm would corrupt a plan
+    comparison.
+    """
+
+    def __init__(self, fits: Dict[Tuple[str, str], CostFit],
+                 meta: Optional[dict] = None):
+        self.fits = dict(fits)
+        self.meta = dict(meta or {})
+
+    # -- prediction ----------------------------------------------------------
+
+    def _fit_for(self, op: str, dtype: str) -> CostFit:
+        if op not in COLLECTIVE_OPS:
+            raise ValueError(
+                f"unknown collective op {op!r}; probed ops are "
+                f"{COLLECTIVE_OPS}")
+        for key in ((op, dtype), (op, "f32")):
+            if key in self.fits:
+                return self.fits[key]
+        for (o, _), fit in sorted(self.fits.items()):
+            if o == op:
+                return fit
+        raise KeyError(f"no fitted curve for op {op!r} "
+                       f"(have {sorted(self.fits)})")
+
+    def predict(self, op: str, nbytes: int, group_size: int,
+                dtype: str = "f32") -> float:
+        """Predicted seconds for one execution of ``op`` moving
+        ``nbytes`` of payload over a ``group_size`` ring."""
+        return self._fit_for(op, dtype).predict(op, nbytes, group_size)
+
+    def predict_stats(self, stats: Dict[str, dict], group_size: int = 0,
+                      dtype: str = "f32") -> Dict[str, dict]:
+        """Predicted per-step communication time for a
+        :func:`~apex_tpu.observability.comms.collective_stats` result.
+
+        Per HLO kind: op count, payload bytes, and predicted seconds
+        (ops without a parsed group size use ``group_size`` as the
+        fallback ring width; 0 means "skip the latency term's hop
+        count scaling" — a 2-wide ring).  Returns the per-kind rows
+        plus ``{"total_s": ...}`` — the objective the auto-parallel
+        planner minimizes alongside compute time.
+        """
+        out: Dict[str, dict] = {}
+        total = 0.0
+        for kind, op in HLO_KIND_TO_OP.items():
+            row = stats.get(kind)
+            if not row or not row.get("count"):
+                continue
+            pred = 0.0
+            for o in row.get("ops", ()):
+                k = o.get("group_size") or group_size or 2
+                pred += self.predict(op, o["bytes"], k, dtype=dtype)
+            out[kind] = {"count": row["count"], "bytes": row["bytes"],
+                         "pred_s": pred, "modeled_as": op}
+            total += pred
+        out["total_s"] = total
+        return out
+
+    # -- validation ----------------------------------------------------------
+
+    def validate(self, measurements: Iterable[Measurement],
+                 tolerance: float = 2.0) -> dict:
+        """Report predicted-vs-measured ratios over ``measurements``
+        (typically a held-out split the fit never saw).  A curve is
+        trustworthy for planning when every ratio lands within
+        ``tolerance`` (the dryrun gate uses 2x)."""
+        rows = []
+        for m in measurements:
+            pred = self.predict(m.op, m.nbytes, m.group_size,
+                                dtype=m.dtype)
+            ratio = (pred / m.time_s if m.time_s > 0 else math.inf)
+            rows.append({"op": m.op, "dtype": m.dtype,
+                         "group_size": m.group_size, "nbytes": m.nbytes,
+                         "measured_s": m.time_s, "pred_s": pred,
+                         "ratio": ratio})
+        ratios = [r["ratio"] for r in rows if math.isfinite(r["ratio"])]
+        worst = max((max(r, 1.0 / r) for r in ratios if r > 0),
+                    default=1.0)
+        return {"n": len(rows), "rows": rows,
+                "worst_ratio": worst,
+                "within_tolerance": bool(worst <= tolerance),
+                "tolerance": tolerance}
+
+    # -- profile JSON --------------------------------------------------------
+
+    def to_json(self) -> dict:
+        return {
+            "version": PROFILE_VERSION,
+            "meta": self.meta,
+            "fits": {f"{op}|{dtype}": {
+                "alpha_s": fit.alpha_s,
+                "beta_s_per_byte": fit.beta_s_per_byte,
+                "n_points": fit.n_points,
+                "max_rel_err": fit.max_rel_err,
+            } for (op, dtype), fit in sorted(self.fits.items())},
+        }
+
+    @classmethod
+    def from_json(cls, doc: dict) -> "CostModel":
+        ver = doc.get("version")
+        if ver != PROFILE_VERSION:
+            raise ValueError(
+                f"machine profile version {ver!r} != supported "
+                f"{PROFILE_VERSION}; re-run tools/comms_probe.py")
+        fits = {}
+        for key, f in doc.get("fits", {}).items():
+            op, _, dtype = key.partition("|")
+            fits[(op, dtype)] = CostFit(
+                alpha_s=float(f["alpha_s"]),
+                beta_s_per_byte=float(f["beta_s_per_byte"]),
+                n_points=int(f.get("n_points", 0)),
+                max_rel_err=float(f.get("max_rel_err", 0.0)))
+        return cls(fits, meta=doc.get("meta"))
+
+    def save(self, path: str,
+             measurements: Optional[Sequence[Measurement]] = None) -> str:
+        """Write the machine profile (fits + meta + optionally the raw
+        measurements, so a later re-fit can improve the model without
+        re-probing)."""
+        doc = self.to_json()
+        if measurements is not None:
+            doc["measurements"] = [m.to_dict() for m in measurements]
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+        return path
+
+
+def load_profile(path: str) -> Tuple[CostModel, List[Measurement]]:
+    """Load a saved machine profile; returns the model and whatever raw
+    measurements the file carried (empty list when none)."""
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    model = CostModel.from_json(doc)
+    ms = [Measurement.from_dict(d) for d in doc.get("measurements", ())]
+    return model, ms
+
+
+def holdout_split(measurements: Sequence[Measurement], every: int = 3
+                  ) -> Tuple[List[Measurement], List[Measurement]]:
+    """(train, held_out): within each (op, dtype, group) curve, hold
+    out every ``every``-th point by size rank — interpolation-regime
+    validation, which is what the planner asks of the model."""
+    curves: Dict[Tuple[str, str, int], List[Measurement]] = {}
+    for m in measurements:
+        curves.setdefault((m.op, m.dtype, m.group_size), []).append(m)
+    train: List[Measurement] = []
+    held: List[Measurement] = []
+    for ms in curves.values():
+        ms = sorted(ms, key=lambda m: m.nbytes)
+        for i, m in enumerate(ms):
+            # never hold out the endpoints: they anchor the fit's range
+            if 0 < i < len(ms) - 1 and i % every == 1 and len(ms) > 2:
+                held.append(m)
+            else:
+                train.append(m)
+    return train, held
+
+
+# ---------------------------------------------------------------------------
+# the probe
+# ---------------------------------------------------------------------------
+
+def _payload_bytes(op: str, dtype: str, n_local: int, k: int) -> int:
+    """Payload bytes under the comms accounting convention (largest
+    shape on the instruction): psum/psum_scatter move the per-device
+    operand, all_gather's payload is the gathered RESULT, ppermute the
+    permuted tensor."""
+    width = _DTYPE_WIDTH[dtype]
+    if op == "all_gather":
+        return n_local * k * width
+    return n_local * width
+
+
+def probe_collectives(ops: Sequence[str] = COLLECTIVE_OPS,
+                      dtypes: Sequence[str] = ("f32", "bf16", "int8"),
+                      sizes: Sequence[int] = (1 << 12, 1 << 14, 1 << 16,
+                                              1 << 18, 1 << 20),
+                      group_sizes: Optional[Sequence[int]] = None,
+                      iters: int = 4, rounds: int = 5,
+                      warmup: int = 1,
+                      verbose: bool = False) -> List[Measurement]:
+    """Microbenchmark the ring collectives on the current backend.
+
+    ``sizes`` are PER-DEVICE local buffer bytes; each (op, dtype,
+    group, size) cell is one jitted shard_map program timed with the
+    hard-sync protocol (1-element device->host readback).  The cell's
+    time is the MIN over ``rounds`` windows of ``iters`` calls — the
+    reproducible lower bound; host scheduling noise only ever ADDS
+    time, and on a 1-core host a single descheduled window would skew
+    a median fit by 2x+.  Cells a backend cannot run
+    (e.g. an unsupported dtype/op pairing) are skipped, not fatal — a
+    partial profile is still a usable profile.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex_tpu.utils.collectives import shard_map_compat
+    from jax.sharding import PartitionSpec as P
+
+    n_devices = len(jax.devices())
+    if group_sizes is None:
+        group_sizes = [k for k in (2, 4, 8) if n_devices % k == 0
+                       and k <= n_devices]
+    if not group_sizes:
+        raise RuntimeError(
+            f"no usable ring sizes on {n_devices} device(s); the probe "
+            "needs >= 2 devices (CPU: set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count)")
+
+    jnp_dtypes = {"f32": jnp.float32, "bf16": jnp.bfloat16,
+                  "int8": jnp.int8}
+
+    def sync(x):
+        leaf = jax.tree_util.tree_leaves(x)[0]
+        np.asarray(jax.device_get(leaf[(0,) * leaf.ndim]))
+        return x
+
+    out: List[Measurement] = []
+    for k in group_sizes:
+        mesh = jax.make_mesh((k,), ("probe",),
+                             devices=jax.devices()[:k])
+        perm = [(i, (i + 1) % k) for i in range(k)]
+        body = {
+            "psum": lambda x: jax.lax.psum(x, "probe"),
+            "all_gather": lambda x: jax.lax.all_gather(
+                x, "probe", tiled=True),
+            "psum_scatter": lambda x: jax.lax.psum_scatter(
+                x, "probe", tiled=True),
+            "ppermute": lambda x: jax.lax.ppermute(
+                x, "probe", perm=perm),
+        }
+        for op in ops:
+            fn = jax.jit(shard_map_compat(
+                body[op], mesh=mesh, in_specs=P("probe"),
+                out_specs=P() if op in ("psum", "all_gather")
+                else P("probe")))
+            for dtype in dtypes:
+                width = _DTYPE_WIDTH[dtype]
+                for nbytes_local in sizes:
+                    # global rows divisible by k for every op; scatter
+                    # additionally splits the local rows k ways
+                    n_local = max(nbytes_local // width, k)
+                    n_local -= n_local % k
+                    n_local = max(n_local, k)
+                    x = jnp.asarray(
+                        np.ones((k * n_local,), np.float32),
+                        jnp_dtypes[dtype])
+                    try:
+                        for _ in range(warmup):
+                            r = fn(x)
+                        sync(r)
+                        times = []
+                        for _ in range(rounds):
+                            t0 = time.perf_counter()
+                            for _ in range(iters):
+                                r = fn(x)
+                            sync(r)
+                            times.append(
+                                (time.perf_counter() - t0) / iters)
+                        t = min(times)
+                    except Exception as e:     # unsupported cell
+                        if verbose:
+                            print(f"probe skip {op}/{dtype}/k={k}/"
+                                  f"{nbytes_local}B: "
+                                  f"{type(e).__name__}: {e}")
+                        continue
+                    m = Measurement(
+                        op=op, dtype=dtype, group_size=k,
+                        nbytes=_payload_bytes(op, dtype, n_local, k),
+                        time_s=t)
+                    out.append(m)
+                    if verbose:
+                        print(f"probe {op:<13} {dtype:<5} k={k} "
+                              f"payload={m.nbytes:>10,}B  "
+                              f"t={t * 1e6:.1f}us")
+    return out

@@ -147,6 +147,16 @@ class Gauge(_Metric):
 
 
 class Histogram(_Metric):
+    """Fixed-boundary bucketed observations.
+
+    Memory is BOUNDED by construction: per label set the histogram
+    holds ``len(buckets)+1`` counts plus a sum/total — never the raw
+    samples — so a serving run observing millions of latencies stays
+    O(buckets).  :meth:`percentile` interpolates quantiles from the
+    bucket counts (choose boundaries that bracket the latencies you
+    care about; the answer is exact only at boundaries).
+    """
+
     kind = "histogram"
 
     def __init__(self, registry, name, help, labelnames,
@@ -182,6 +192,31 @@ class Histogram(_Metric):
 
     def sum(self, **labels) -> float:
         return self._sums.get(_label_key(self.labelnames, labels), 0.0)
+
+    def percentile(self, q: float, **labels) -> float:
+        """The q-quantile (``0 <= q <= 1``) interpolated from bucket
+        counts — ``histogram_quantile`` semantics: linear within the
+        selected bucket, saturating at the top finite boundary for
+        observations in the overflow bucket; 0.0 with no samples."""
+        key = _label_key(self.labelnames, labels)
+        with self._registry._lock:
+            counts = list(self._counts.get(key, ()))
+            total = self._totals.get(key, 0)
+        if not total:
+            return 0.0
+        rank = q * total
+        cum = 0.0
+        for i, c in enumerate(counts):
+            if not c:
+                continue
+            if cum + c >= rank:
+                if i >= len(self.buckets):
+                    return self.buckets[-1]
+                lo = self.buckets[i - 1] if i > 0 else 0.0
+                return lo + (self.buckets[i] - lo) \
+                    * max(rank - cum, 0.0) / c
+            cum += c
+        return self.buckets[-1]               # pragma: no cover
 
     def _samples(self):
         for key in sorted(self._counts):

@@ -63,9 +63,16 @@ class Tracer:
         depth = len(stack)
         t0 = self.clock()
         cm = jax.named_scope(name) if device else contextlib.nullcontext()
+        error = None
         try:
             with cm:
                 yield
+        except BaseException as e:
+            # the span still closes (and the stack still pops) when the
+            # body raises; the event records what detonated so the
+            # trace shows WHERE the exception path spent its time
+            error = type(e).__name__
+            raise
         finally:
             dt = self.clock() - t0
             popped = stack.pop()
@@ -76,8 +83,10 @@ class Tracer:
             ev = {"name": name, "ph": "X", "cat": "host",
                   "ts": t0 * 1e6, "dur": dt * 1e6,
                   "pid": os.getpid(), "tid": threading.get_ident()}
-            if args or depth > 1:
+            if args or depth > 1 or error:
                 ev["args"] = {**args, "depth": depth}
+                if error:
+                    ev["args"]["error"] = error
             with self._lock:
                 self._events.append(ev)
 
@@ -87,6 +96,43 @@ class Tracer:
         ev = {"name": name, "ph": "i", "cat": "host", "s": "t",
               "ts": self.clock() * 1e6,
               "pid": os.getpid(), "tid": threading.get_ident()}
+        if args:
+            ev["args"] = dict(args)
+        with self._lock:
+            self._events.append(ev)
+
+    # -- async (per-flow) events ---------------------------------------------
+    #
+    # Host spans live on thread tracks; a REQUEST's lifecycle hops
+    # threads and interleaves with other requests, so it gets a
+    # nestable async track instead: Perfetto groups events sharing
+    # (cat, id) onto one row per flow — one row per request.
+
+    def async_span(self, name: str, id: object, ts: float, dur: float,
+                   cat: str = "request", **args) -> None:
+        """One closed async slice on flow ``(cat, id)``: a ``ph: "b"``
+        / ``ph: "e"`` nestable pair at ``ts``..``ts + dur`` (seconds on
+        this tracer's clock).  Emitted after the fact — the request
+        tracer records raw timestamps on the hot path and materializes
+        trace events once, at request completion."""
+        ident = str(id)
+        pid = os.getpid()
+        begin = {"name": name, "ph": "b", "cat": cat, "id": ident,
+                 "ts": ts * 1e6, "pid": pid, "tid": pid}
+        if args:
+            begin["args"] = dict(args)
+        end = {"name": name, "ph": "e", "cat": cat, "id": ident,
+               "ts": (ts + dur) * 1e6, "pid": pid, "tid": pid}
+        with self._lock:
+            self._events.append(begin)
+            self._events.append(end)
+
+    def async_instant(self, name: str, id: object, ts: float,
+                      cat: str = "request", **args) -> None:
+        """A point event (``ph: "n"``) on flow ``(cat, id)`` — decode
+        ticks, admission edges."""
+        ev = {"name": name, "ph": "n", "cat": cat, "id": str(id),
+              "ts": ts * 1e6, "pid": os.getpid(), "tid": os.getpid()}
         if args:
             ev["args"] = dict(args)
         with self._lock:

@@ -30,7 +30,9 @@ same series for scrape-style collection.
 
 from __future__ import annotations
 
+import collections
 import functools
+import statistics
 import time
 from typing import Any, Callable, Optional
 
@@ -89,6 +91,17 @@ class TrainingMonitor:
     once, lazily, at the first monitored step).  ``registry`` defaults
     to a fresh :class:`MetricsRegistry`; pass ``stream_path`` to open a
     JSONL event stream on it.  ``clock`` is injectable for tests.
+
+    Straggler visibility: every step sets ``train_step_time_skew`` —
+    this step's time over the rolling median of the last
+    ``skew_window`` steps, minus one (0.0 = on trend; 1.0 = a 2× step)
+    — the single-host "is something stalling" gauge.  Under
+    multi-controller JAX, ``straggler_every=N`` additionally
+    all-gathers step time across hosts every N steps and sets
+    ``train_straggler_ratio`` (slowest/fastest host); it costs a host
+    sync per sample, so it defaults to off (0).  ``slo=`` feeds each
+    step time to an :class:`~apex_tpu.observability.slo.SLOMonitor`
+    as metric ``"step_time"``.
     """
 
     def __init__(self, registry: Optional[MetricsRegistry] = None, *,
@@ -96,7 +109,10 @@ class TrainingMonitor:
                  flops_per_token: Optional[float] = None,
                  peak_flops: Any = None,
                  stream_path: Optional[str] = None,
-                 clock: Callable[[], float] = time.perf_counter):
+                 clock: Callable[[], float] = time.perf_counter,
+                 slo: Any = None,
+                 skew_window: int = 32,
+                 straggler_every: int = 0):
         self.registry = registry if registry is not None \
             else MetricsRegistry()
         if stream_path is not None:
@@ -105,6 +121,10 @@ class TrainingMonitor:
         self.tokens_per_step = tokens_per_step
         self.flops_per_token = flops_per_token
         self.peak_flops = peak_flops
+        self.slo = slo
+        self.straggler_every = straggler_every
+        self._recent_dt: collections.deque = \
+            collections.deque(maxlen=max(skew_window, 2))
         self.steps = 0
         self._totals = {"anomalies": 0, "rollbacks": 0, "time_s": 0.0}
         r = self.registry
@@ -127,6 +147,12 @@ class TrainingMonitor:
             labelnames=("kind",))
         self._c_roll = r.counter("train_rollbacks_total",
                                  "checkpoint rollbacks")
+        self._g_skew = r.gauge(
+            "train_step_time_skew",
+            "step time / rolling median - 1 (0 = on trend)")
+        self._g_straggler = r.gauge(
+            "train_straggler_ratio",
+            "slowest/fastest host step time (multi-controller only)")
 
     # -- wiring --------------------------------------------------------------
 
@@ -160,6 +186,22 @@ class TrainingMonitor:
         self._c_steps.inc()
         rec = {"step": int(step), "step_time_s": dt,
                "anomalies": self._totals["anomalies"]}
+
+        # skew vs the rolling median of RECENT steps (this step is
+        # appended after the read, so a stall shows against the trend
+        # rather than diluting it)
+        med = statistics.median(self._recent_dt) if self._recent_dt else dt
+        skew = (dt / med - 1.0) if med > 0 else 0.0
+        self._recent_dt.append(dt)
+        self._g_skew.set(skew)
+        rec["step_time_skew"] = skew
+        if self.slo is not None:
+            self.slo.observe("step_time", dt)
+        if self.straggler_every and self.steps % self.straggler_every == 0:
+            ratio = self._straggler_ratio(dt)
+            if ratio is not None:
+                self._g_straggler.set(ratio)
+                rec["straggler_ratio"] = ratio
 
         if self.tokens_per_step:
             tps = self.tokens_per_step / dt if dt > 0 else 0.0
@@ -201,6 +243,24 @@ class TrainingMonitor:
             rec["rolled_back"] = True
             self._c_roll.inc()
         self.registry.event("train_step", **rec)
+
+    @staticmethod
+    def _straggler_ratio(dt: float) -> Optional[float]:
+        """slowest/fastest host step time via a process all-gather;
+        None single-controller (the skew gauge covers that case)."""
+        import jax
+        if jax.process_count() <= 1:
+            return None
+        try:
+            import numpy as np
+            from jax.experimental import multihost_utils
+
+            all_dt = np.asarray(multihost_utils.process_allgather(
+                np.float32(dt)))
+            lo = float(np.min(all_dt))
+            return float(np.max(all_dt)) / max(lo, 1e-12)
+        except Exception:           # pragma: no cover - backend-specific
+            return None
 
     def _resolve_peak(self) -> Optional[float]:
         if self.peak_flops == "calibrated":

@@ -24,6 +24,7 @@ Nsight.  The TPU equivalents wired here:
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import hashlib
 import threading
@@ -181,20 +182,39 @@ class ServingMetrics:
     dropped when a request reaches ANY terminal state — finished,
     evicted, errored or timed out — so a long-running engine no longer
     leaks an entry per request that finished without tokens.
+
+    Memory is BOUNDED: raw-sample retention (``ttft`` /
+    ``token_latencies`` / ``occupancy`` / ``queue_waits`` /
+    ``decode_ticks``) keeps the most recent ``max_samples`` entries —
+    :meth:`summary` percentiles are exact over that window, while the
+    registry histograms (fixed buckets) and counters aggregate the full
+    run.  A serving process that runs for weeks holds O(max_samples)
+    state, not O(requests).
     """
 
     def __init__(self, clock: Callable[[], float] = time.monotonic,
-                 registry: Optional[Any] = None):
+                 registry: Optional[Any] = None, *,
+                 slo: Optional[Any] = None,
+                 max_samples: int = 4096):
         from apex_tpu.observability import MetricsRegistry
 
         self.clock = clock
         self.registry = registry if registry is not None \
             else MetricsRegistry(clock=clock)
+        self.slo = slo                   # optional observability.SLOMonitor
+        self.max_samples = max_samples
         self._submitted: dict = {}       # request_id -> submit time
         self._last_token: dict = {}      # request_id -> last token time
-        self.ttft: dict = {}             # request_id -> seconds
-        self.token_latencies: list = []  # seconds, across all requests
-        self.occupancy: list = []        # (active, total) per engine step
+        self.ttft: dict = collections.OrderedDict()   # request_id -> s
+        self.token_latencies: collections.deque = \
+            collections.deque(maxlen=max_samples)
+        self.occupancy: collections.deque = \
+            collections.deque(maxlen=max_samples)  # (active, total)/step
+        self.queue_waits: collections.deque = \
+            collections.deque(maxlen=max_samples)  # enqueue->admit, s
+        self.decode_ticks: collections.deque = \
+            collections.deque(maxlen=max_samples)  # ticks per request
+        self._first_tokens = 0           # requests that reached a token
         self.tokens_emitted = 0
         self.evicted = 0                 # deadline evictions (active+queued)
         self.errors = 0                  # poison requests quarantined
@@ -216,6 +236,13 @@ class ServingMetrics:
                                     "active/total slots (last step)")
         self._g_queue = r.gauge("serving_active_requests",
                                 "requests currently admitted")
+        self._h_queue_wait = r.histogram(
+            "serving_queue_wait_seconds",
+            "enqueue -> admission wait (from the request trace)")
+        self._h_ticks = r.histogram(
+            "serving_decode_ticks",
+            "decode ticks per request (from the request trace)",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024))
 
     def request_submitted(self, request_id) -> None:
         self._submitted[request_id] = self.clock()
@@ -225,11 +252,17 @@ class ServingMetrics:
 
     def first_token(self, request_id) -> None:
         now = self.clock()
-        self.ttft[request_id] = now - self._submitted.get(request_id, now)
+        ttft = now - self._submitted.get(request_id, now)
+        self.ttft[request_id] = ttft
+        while len(self.ttft) > self.max_samples:
+            self.ttft.popitem(last=False)
         self._last_token[request_id] = now
+        self._first_tokens += 1
         self.tokens_emitted += 1
-        self._h_ttft.observe(self.ttft[request_id])
+        self._h_ttft.observe(ttft)
         self._c_tokens.inc()
+        if self.slo is not None:
+            self.slo.observe("ttft", ttft)
 
     def token(self, request_id) -> None:
         now = self.clock()
@@ -237,9 +270,24 @@ class ServingMetrics:
         if prev is not None:
             self.token_latencies.append(now - prev)
             self._h_latency.observe(now - prev)
+            if self.slo is not None:
+                self.slo.observe("token_latency", now - prev)
         self._last_token[request_id] = now
         self.tokens_emitted += 1
         self._c_tokens.inc()
+
+    def request_admitted(self, request_id, queue_wait_s: float) -> None:
+        """Admission edge, fed by the request trace: ``queue_wait_s`` is
+        the measured enqueue→admit wait on the trace's clock."""
+        self.queue_waits.append(queue_wait_s)
+        self._h_queue_wait.observe(queue_wait_s)
+        if self.slo is not None:
+            self.slo.observe("queue_wait", queue_wait_s)
+
+    def request_decode_ticks(self, request_id, ticks: int) -> None:
+        """Decode ticks a completed request consumed (request trace)."""
+        self.decode_ticks.append(int(ticks))
+        self._h_ticks.observe(ticks)
 
     def step(self, active_slots: int, total_slots: int) -> None:
         self.occupancy.append((active_slots, total_slots))
@@ -298,7 +346,7 @@ class ServingMetrics:
         occ = ([a / t for a, t in self.occupancy if t]
                if self.occupancy else [])
         return {
-            "requests": len(self.ttft),
+            "requests": self._first_tokens,
             "tokens": self.tokens_emitted,
             "evicted": self.evicted,
             "errors": self.errors,
@@ -309,5 +357,7 @@ class ServingMetrics:
             "ttft_max_s": max(self.ttft.values()) if self.ttft else 0.0,
             "token_latency_p50_s": self._pct(self.token_latencies, 0.5),
             "token_latency_p90_s": self._pct(self.token_latencies, 0.9),
+            "queue_wait_p50_s": self._pct(self.queue_waits, 0.5),
+            "decode_ticks_p50": self._pct(self.decode_ticks, 0.5),
             "slot_occupancy_mean": (sum(occ) / len(occ)) if occ else 0.0,
         }

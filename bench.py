@@ -1190,6 +1190,84 @@ def bench_observability():
             "stream_ok": bool(stream_ok)}
 
 
+def bench_serving_observability():
+    """Serving-observability leg (ISSUE 7): what per-request tracing +
+    SLO monitoring cost on the decode loop.
+
+    The SAME continuous-batching engine workload (submit a batch of
+    requests, drive ``step()`` to completion) run with default metrics
+    vs fully instrumented — a ``Tracer`` attached (per-request async
+    spans materialized at completion), an ``SLOMonitor`` classifying
+    TTFT/token-latency/queue-wait, and the queue-wait/decode-ticks
+    series live.  The hot-path additions are dict writes and int
+    increments; span events materialize once per request, so the
+    acceptance target is < 2% (paired windows, median per-pass ratio,
+    same protocol as the training-observability leg)."""
+    from apex_tpu.inference import InferenceEngine, Request
+    from apex_tpu.models.gpt import GPTConfig, GPTModel
+    from apex_tpu.observability import (MetricsRegistry, SLOMonitor,
+                                        SLOTarget, Tracer)
+    from apex_tpu.utils.profiling import ServingMetrics
+
+    _free_calibration()
+    cfg = GPTConfig(vocab_size=1024, hidden_size=256, num_layers=2,
+                    num_attention_heads=8, max_seq_len=128)
+    model = GPTModel(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(7)
+    prompts = [list(rng.randint(1, cfg.vocab_size, 12)) for _ in range(8)]
+
+    eng_bare = InferenceEngine(model, params, max_slots=4)
+    tracer = Tracer(clock=time.monotonic)     # engine's clock domain
+    slo = SLOMonitor([SLOTarget("ttft", 0.5, objective=0.95),
+                      SLOTarget("token_latency", 0.1, objective=0.99)],
+                     clock=time.monotonic)
+    metrics = ServingMetrics(time.monotonic,
+                             registry=MetricsRegistry(), slo=slo)
+    eng_traced = InferenceEngine(model, params, max_slots=4,
+                                 metrics=metrics, tracer=tracer)
+
+    ids = {"n": 0}
+
+    def run(eng):
+        for p in prompts:
+            ids["n"] += 1
+            eng.submit(Request(request_id=ids["n"], prompt=p,
+                               max_new_tokens=16))
+        while eng.step():
+            pass
+
+    run(eng_bare)                             # compile outside timing
+    run(eng_traced)
+    passes = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        run(eng_bare)
+        t_b = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        run(eng_traced)
+        t_t = time.perf_counter() - t0
+        passes.append((t_b, t_t))
+        tracer.clear()                        # bound trace growth
+    passes.sort(key=lambda p: p[1] / p[0])
+    t_bare, t_traced = passes[len(passes) // 2]
+    overhead = t_traced / t_bare - 1.0
+
+    # the instrumented arm must actually have produced its artifacts
+    n_done = ids["n"] - len(prompts)          # warmup pass excluded
+    trace_ok = (eng_traced.trace.pending == 0
+                and len(metrics.decode_ticks) > 0
+                and metrics._h_queue_wait.count() == ids["n"] // 2
+                and slo.snapshot()["percentiles"]["ttft"]["n"] > 0)
+    return {"bare_window_s": round(t_bare, 6),
+            "traced_window_s": round(t_traced, 6),
+            "trace_overhead_frac": round(overhead, 4),
+            "trace_overhead_target": 0.02,
+            "trace_overhead_ok": bool(overhead < 0.02),
+            "requests_per_window": len(prompts),
+            "trace_ok": bool(trace_ok)}
+
+
 def main():
     backend = jax.default_backend()
     # every leg's result also lands on the metrics registry as one
@@ -1219,6 +1297,7 @@ def main():
     pp_schedules = _retry(bench_pp_schedules)
     resilience = _retry(bench_resilience)
     observability = _retry(bench_observability)
+    serving_obs = _retry(bench_serving_observability)
     rounded = lambda d: (None if d is None else
                          {k: (round(v, 6) if isinstance(v, float) else v)
                           for k, v in d.items()})
@@ -1245,6 +1324,7 @@ def main():
             "pp_schedules": pp_schedules,
             "resilience": resilience,
             "observability": rounded(observability),
+            "serving_observability": rounded(serving_obs),
         },
     }
     result["metrics_stream"] = stream_path

@@ -499,8 +499,159 @@ def test_public_exports():
                  "replay_jsonl", "Tracer", "default_tracer", "span",
                  "TrainingMonitor", "calibrated_peak_flops",
                  "collective_stats", "hlo_collective_stats",
-                 "wire_bytes", "format_stats"):
+                 "wire_bytes", "format_stats",
+                 "CostModel", "Measurement", "fit_cost_model",
+                 "load_profile", "probe_collectives",
+                 "RequestRecord", "RequestTracer",
+                 "BurnWindow", "RollingPercentiles",
+                 "SLOMonitor", "SLOTarget"):
         assert hasattr(obs, name), name
     assert isinstance(obs.MetricsRegistry().counter("x_total"), Counter)
     assert isinstance(obs.MetricsRegistry().gauge("g"), Gauge)
     assert isinstance(obs.MetricsRegistry().histogram("h"), Histogram)
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exporter edge cases (ISSUE 7 satellite)
+# ---------------------------------------------------------------------------
+
+class TestPrometheusEdgeCases:
+    def test_empty_registry_renders_empty(self):
+        assert MetricsRegistry().prometheus() == ""
+
+    def test_label_value_escaping(self):
+        reg = MetricsRegistry()
+        c = reg.counter("esc_total", "esc", labelnames=("v",))
+        c.inc(v='say "hi"')
+        c.inc(v="back\\slash")
+        c.inc(v="two\nlines")
+        prom = reg.prometheus()
+        assert r'esc_total{v="say \"hi\""} 1' in prom
+        assert r'esc_total{v="back\\slash"} 1' in prom
+        assert r'esc_total{v="two\nlines"} 1' in prom
+        assert "\nlines" not in prom.replace("\\nlines", "")
+
+    def test_no_help_omits_help_line(self):
+        reg = MetricsRegistry()
+        reg.gauge("bare").set(1)
+        prom = reg.prometheus()
+        assert "# HELP" not in prom and "# TYPE bare gauge" in prom
+
+    def test_labeled_histogram_rendering(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat_seconds", "lat", labelnames=("op",),
+                          buckets=(0.25, 0.5))
+        for v in (0.1, 0.3, 9.0):
+            h.observe(v, op="read")
+        h.observe(0.4, op="write")
+        prom = reg.prometheus()
+        # per-label-set cumulative buckets, le last inside the braces
+        assert 'lat_seconds_bucket{op="read",le="0.25"} 1' in prom
+        assert 'lat_seconds_bucket{op="read",le="0.5"} 2' in prom
+        assert 'lat_seconds_bucket{op="read",le="+Inf"} 3' in prom
+        assert 'lat_seconds_bucket{op="write",le="+Inf"} 1' in prom
+        assert 'lat_seconds_sum{op="read"} 9.4' in prom
+        assert 'lat_seconds_count{op="read"} 3' in prom
+        assert 'lat_seconds_count{op="write"} 1' in prom
+
+    def test_inf_and_int_value_formatting(self):
+        reg = MetricsRegistry()
+        reg.gauge("pos").set(float("inf"))
+        reg.gauge("neg").set(float("-inf"))
+        reg.gauge("whole").set(3.0)
+        prom = reg.prometheus()
+        assert "pos +Inf" in prom and "neg -Inf" in prom
+        assert "whole 3\n" in prom            # 3.0 renders as 3
+
+
+class TestHistogramPercentile:
+    def test_interpolated_within_bucket(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("h", buckets=(1.0, 2.0, 4.0))
+        for v in (0.5, 1.5, 1.6, 3.0):
+            h.observe(v)
+        # rank 2 of 4 lands at the top of the (1,2] bucket's first half
+        assert 0.0 < h.percentile(0.25) <= 1.0
+        assert 1.0 < h.percentile(0.5) <= 2.0
+        assert 2.0 < h.percentile(1.0) <= 4.0
+
+    def test_empty_and_overflow(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("h", buckets=(1.0, 2.0))
+        assert h.percentile(0.5) == 0.0
+        h.observe(100.0)                      # overflow bucket
+        assert h.percentile(0.99) == 2.0      # saturates at top boundary
+
+    def test_labeled(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("h", labelnames=("k",), buckets=(1.0, 2.0))
+        h.observe(0.5, k="a")
+        h.observe(1.5, k="b")
+        assert h.percentile(1.0, k="a") <= 1.0
+        assert h.percentile(1.0, k="b") > 1.0
+        with pytest.raises(ValueError):
+            h.percentile(0.5)                 # missing label
+
+
+# ---------------------------------------------------------------------------
+# Tracer exception-path nesting (ISSUE 7 satellite)
+# ---------------------------------------------------------------------------
+
+class TestTracerExceptionPath:
+    def test_span_closes_and_flags_on_raise(self):
+        t = [0.0]
+
+        def clk():
+            t[0] += 1.0
+            return t[0]
+
+        tr = Tracer(clock=clk)
+        with pytest.raises(RuntimeError, match="boom"):
+            with tr.span("work"):
+                raise RuntimeError("boom")
+        assert tr.depth() == 0                # stack popped
+        (ev,) = tr.events
+        assert ev["name"] == "work" and ev["dur"] == pytest.approx(1e6)
+        assert ev["args"]["error"] == "RuntimeError"
+        json.loads(tr.to_json())              # still valid Chrome JSON
+
+    def test_inner_exception_does_not_flag_outer(self):
+        tr = Tracer()
+        with tr.span("outer"):
+            try:
+                with tr.span("inner"):
+                    raise ValueError("x")
+            except ValueError:
+                pass
+        inner, outer = tr.events              # inner closes first
+        assert inner["name"] == "inner"
+        assert inner["args"]["error"] == "ValueError"
+        assert outer["name"] == "outer"
+        assert "error" not in outer.get("args", {})
+        assert tr.depth() == 0
+
+    def test_nesting_survives_exception_for_next_span(self):
+        tr = Tracer()
+        try:
+            with tr.span("a"):
+                raise KeyError("k")
+        except KeyError:
+            pass
+        with tr.span("b"):
+            pass
+        names = [e["name"] for e in tr.events]
+        assert names == ["a", "b"]
+        assert all(e.get("args", {}).get("depth", 1) == 1
+                   for e in tr.events)
+
+    def test_async_span_event_shape(self):
+        tr = Tracer(clock=lambda: 0.0)
+        tr.async_span("request", 7, ts=1.0, dur=0.5, reason="eos")
+        tr.async_instant("tick", 7, ts=1.2)
+        b, e, n = tr.events
+        assert (b["ph"], e["ph"], n["ph"]) == ("b", "e", "n")
+        assert b["id"] == e["id"] == n["id"] == "7"
+        assert b["cat"] == "request" and b["ts"] == pytest.approx(1e6)
+        assert e["ts"] == pytest.approx(1.5e6)
+        assert b["args"] == {"reason": "eos"}
+        json.loads(tr.to_json())

@@ -1,0 +1,270 @@
+"""apex_tpu.observability.request_trace: per-request lifecycle tracing.
+
+Unit tests run the lifecycle against a fake clock so every derived
+quantity (queue wait, prefill, decode, TTFT, TPOT) is exact; the
+integration test drives the real continuous-batching engine with a
+tracer attached and checks the spans/metrics/records agree.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from apex_tpu.inference import InferenceEngine, Request
+from apex_tpu.models.gpt import GPTConfig, GPTModel
+from apex_tpu.observability import (
+    MetricsRegistry,
+    RequestRecord,
+    RequestTracer,
+    Tracer,
+)
+from apex_tpu.utils.profiling import ServingMetrics
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class RecordingMetrics:
+    """Duck-typed ServingMetrics sink — records the trace's feed."""
+
+    def __init__(self):
+        self.admitted = []
+        self.ticks = []
+
+    def request_admitted(self, request_id, queue_wait_s):
+        self.admitted.append((request_id, queue_wait_s))
+
+    def request_decode_ticks(self, request_id, ticks):
+        self.ticks.append((request_id, ticks))
+
+
+class TestLifecycle:
+    def test_full_lifecycle_derived_quantities(self):
+        clk = FakeClock()
+        rt = RequestTracer(clock=clk)
+        rt.enqueue("r1")
+        clk.t = 1.0
+        rt.admit("r1")
+        clk.t = 3.0
+        rt.first_token("r1")
+        for _ in range(3):
+            rt.decode_tick("r1")
+        clk.t = 6.0
+        rec = rt.finish("r1", "eos")
+        assert isinstance(rec, RequestRecord)
+        assert rec.queue_wait_s == 1.0
+        assert rec.prefill_s == 2.0
+        assert rec.decode_s == 3.0
+        assert rec.ticks == 3
+        # TTFT/TPOT are DERIVED, not separately measured
+        assert rec.ttft_s == 3.0
+        assert rec.tpot_s == 1.0
+        assert rec.reason == "eos" and rec.error is None
+        assert rt.pending == 0
+
+    def test_never_admitted(self):
+        clk = FakeClock()
+        rt = RequestTracer(clock=clk)
+        rt.enqueue("r1")
+        clk.t = 5.0
+        rec = rt.finish("r1", "evicted")
+        # queue phase absorbs the whole life; later phases undefined
+        assert rec.queue_wait_s == 5.0
+        assert rec.prefill_s is None and rec.decode_s is None
+        assert rec.ttft_s is None and rec.tpot_s is None
+
+    def test_admitted_without_first_token(self):
+        clk = FakeClock()
+        rt = RequestTracer(clock=clk)
+        rt.enqueue("r1")
+        clk.t = 1.0
+        rt.admit("r1")
+        clk.t = 4.0
+        rec = rt.finish("r1", "error", error="RuntimeError")
+        # open prefill absorbs time to finish; no decode phase
+        assert rec.prefill_s == 3.0 and rec.decode_s is None
+        assert rec.ttft_s is None
+        assert rec.error == "RuntimeError"
+
+    def test_unknown_or_double_finish_returns_none(self):
+        rt = RequestTracer(clock=FakeClock())
+        assert rt.finish("ghost", "eos") is None
+        rt.enqueue("r1")
+        rt.finish("r1", "eos")
+        assert rt.finish("r1", "eos") is None
+        assert len(rt.records) == 1
+
+    def test_records_bounded(self):
+        rt = RequestTracer(clock=FakeClock(), keep=4)
+        for i in range(10):
+            rt.enqueue(i)
+            rt.finish(i, "eos")
+        assert len(rt.records) == 4
+        assert [r.request_id for r in rt.records] == [6, 7, 8, 9]
+
+    def test_metrics_feed(self):
+        clk = FakeClock()
+        m = RecordingMetrics()
+        rt = RequestTracer(clock=clk, metrics=m)
+        rt.enqueue("a")
+        clk.t = 2.0
+        rt.admit("a")
+        rt.first_token("a")
+        rt.decode_tick("a")
+        rt.decode_tick("a")
+        rt.finish("a", "eos")
+        # never-admitted request must NOT report decode ticks
+        rt.enqueue("b")
+        rt.finish("b", "evicted")
+        assert m.admitted == [("a", 2.0)]
+        assert m.ticks == [("a", 2)]
+
+    def test_summary_percentiles(self):
+        clk = FakeClock()
+        rt = RequestTracer(clock=clk)
+        for i in range(4):
+            rt.enqueue(i)
+            clk.t += 1.0
+            rt.admit(i)
+            clk.t += 1.0
+            rt.first_token(i)
+            rt.decode_tick(i)
+            clk.t += 2.0
+            rt.finish(i, "eos")
+        s = rt.summary()
+        assert s["requests"] == 4
+        assert s["ttft_p50_s"] == 2.0          # 1s queue + 1s prefill
+        assert s["tpot_p50_s"] == 2.0          # 2s decode / 1 tick
+        assert s["queue_wait_p50_s"] == 1.0
+
+
+class TestSpanEmission:
+    def test_tracer_clock_wins(self):
+        other = FakeClock(100.0)
+        tr = Tracer(clock=FakeClock(5.0))
+        rt = RequestTracer(clock=other, tracer=tr)
+        assert rt.clock is tr.clock
+
+    def test_nested_async_spans_tile_the_request(self):
+        clk = FakeClock()
+        tr = Tracer(clock=clk)
+        rt = RequestTracer(tracer=tr)
+        rt.enqueue(7)
+        clk.t = 1.0
+        rt.admit(7)
+        clk.t = 2.0
+        rt.first_token(7)
+        rt.decode_tick(7)
+        clk.t = 5.0
+        rt.finish(7, "eos")
+        evs = tr.events
+        by_name = {}
+        for e in evs:
+            by_name.setdefault(e["name"], []).append(e)
+        assert set(by_name) == {"request", "queue_wait", "prefill",
+                                "decode"}
+        for name, pair in by_name.items():
+            assert [e["ph"] for e in pair] == ["b", "e"]
+            assert all(e["id"] == "7" for e in pair)
+            assert all(e["cat"] == "request" for e in pair)
+        # µs timestamps tile: queue 0-1s, prefill 1-2s, decode 2-5s
+        def span_us(name):
+            b, e = by_name[name]
+            return b["ts"], e["ts"]
+        assert span_us("request") == (0.0, pytest.approx(5e6))
+        assert span_us("queue_wait") == (0.0, pytest.approx(1e6))
+        assert span_us("prefill") == (pytest.approx(1e6),
+                                      pytest.approx(2e6))
+        assert span_us("decode") == (pytest.approx(2e6),
+                                     pytest.approx(5e6))
+        req_b = by_name["request"][0]
+        assert req_b["args"] == {"reason": "eos", "ticks": 1}
+        assert by_name["decode"][0]["args"] == {"ticks": 1}
+
+    def test_error_recorded_on_request_span(self):
+        tr = Tracer(clock=FakeClock())
+        rt = RequestTracer(tracer=tr)
+        rt.enqueue(1)
+        rt.finish(1, "error", error="ValueError")
+        req = [e for e in tr.events
+               if e["name"] == "request" and e["ph"] == "b"][0]
+        assert req["args"]["error"] == "ValueError"
+        # no prefill/decode spans for a request that never ran
+        assert {e["name"] for e in tr.events} == {"request",
+                                                  "queue_wait"}
+
+
+class TestEngineIntegration:
+    def _engine(self, **kw):
+        cfg = GPTConfig(vocab_size=32, hidden_size=16, num_layers=2,
+                        num_attention_heads=2, max_seq_len=16)
+        model = GPTModel(cfg)
+        params = model.init_params(jax.random.PRNGKey(0))
+        return InferenceEngine(model, params, max_slots=2,
+                               cache_dtype=jnp.float32, **kw)
+
+    def test_engine_populates_trace_and_spans(self):
+        t = [0.0]
+
+        def clock():
+            t[0] += 0.25
+            return t[0]
+
+        tr = Tracer(clock=clock)
+        reg = MetricsRegistry()
+        eng = self._engine(
+            tracer=tr,
+            metrics=ServingMetrics(clock, registry=reg))
+        for i in range(3):
+            eng.submit(Request(request_id=i, prompt=[1 + i, 2],
+                               max_new_tokens=3))
+        out = eng.run()
+        assert len(out) == 3
+        assert eng.trace.pending == 0          # no leaked live entries
+        recs = {r.request_id: r for r in eng.trace.records}
+        assert set(recs) == {0, 1, 2}
+        for r in recs.values():
+            assert r.reason == "length"
+            assert r.ttft_s is not None and r.ttft_s > 0
+            assert r.ticks == 2                # 3 tokens = first + 2
+            assert r.tpot_s is not None and r.tpot_s > 0
+        # every request got the four nested async spans
+        names = [e["name"] for e in tr.events if e["ph"] == "b"]
+        assert names.count("request") == 3
+        assert names.count("decode") == 3
+        # the trace fed ServingMetrics: queue-wait + decode-tick series
+        assert eng.metrics._h_queue_wait.count() == 3
+        assert list(eng.metrics.decode_ticks) == [2, 2, 2]
+        s = eng.metrics.summary()
+        assert s["queue_wait_p50_s"] >= 0.0
+        assert s["decode_ticks_p50"] == 2
+
+    def test_eviction_reason_reaches_records(self):
+        t = [0.0]
+
+        def clock():
+            t[0] += 1.0
+            return t[0]
+
+        eng = self._engine(clock=clock)
+        eng.submit(Request(request_id=0, prompt=[1, 2],
+                           max_new_tokens=100, deadline=20.0))
+        (r,) = eng.run(max_steps=100)
+        assert r.finish_reason == "evicted"
+        (rec,) = eng.trace.records
+        assert rec.reason == "evicted"
+        assert eng.trace.pending == 0
+
+    def test_default_engine_has_trace_without_tracer(self):
+        eng = self._engine()
+        eng.submit(Request(request_id=0, prompt=[1, 2],
+                           max_new_tokens=2))
+        eng.run()
+        assert eng.trace.tracer is None
+        assert len(eng.trace.records) == 1
+        assert eng.trace.summary()["requests"] == 1

@@ -1,0 +1,208 @@
+"""apex_tpu.observability.slo: rolling percentiles + burn-rate alerts.
+
+Everything runs against an injected fake clock, so window expiry and
+multi-window alert gating are exact — no sleeps, no wall-clock flake.
+"""
+
+import pytest
+
+from apex_tpu.observability import (
+    BurnWindow,
+    MetricsRegistry,
+    RollingPercentiles,
+    SLOMonitor,
+    SLOTarget,
+)
+from apex_tpu.observability.slo import DEFAULT_BURN_WINDOWS, _WindowedCounts
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class TestRollingPercentiles:
+    def test_interpolation_within_bucket(self):
+        clk = FakeClock()
+        rp = RollingPercentiles(buckets=(1.0, 2.0, 4.0), window_s=60,
+                                slots=6, clock=clk)
+        for _ in range(10):
+            rp.observe(1.5)            # all land in the (1, 2] bucket
+        assert rp.count() == 10
+        # rank interpolates linearly across the bucket span
+        assert rp.percentile(0.5) == pytest.approx(1.5)
+        assert rp.percentile(1.0) == pytest.approx(2.0)
+        # first bucket interpolates from 0
+        rp2 = RollingPercentiles(buckets=(1.0, 2.0), window_s=60,
+                                 slots=6, clock=clk)
+        rp2.observe(0.2)
+        assert 0.0 < rp2.percentile(0.5) <= 1.0
+
+    def test_overflow_saturates_at_top_boundary(self):
+        rp = RollingPercentiles(buckets=(1.0, 2.0), window_s=60,
+                                slots=6, clock=FakeClock())
+        rp.observe(100.0)
+        assert rp.percentile(0.99) == 2.0
+
+    def test_empty_window_is_zero(self):
+        rp = RollingPercentiles(window_s=60, slots=6, clock=FakeClock())
+        assert rp.percentile(0.95) == 0.0 and rp.count() == 0
+
+    def test_window_forgets(self):
+        clk = FakeClock()
+        rp = RollingPercentiles(buckets=(1.0, 2.0, 4.0), window_s=60,
+                                slots=6, clock=clk)
+        rp.observe(3.0)
+        assert rp.count() == 1
+        clk.advance(61.0)              # past the window -> slot expires
+        assert rp.count() == 0
+        rp.observe(1.5)                # fresh slot still works
+        assert rp.count() == 1 and rp.percentile(0.5) < 2.0
+
+    def test_memory_bounded_by_slots(self):
+        clk = FakeClock()
+        rp = RollingPercentiles(window_s=60, slots=6, clock=clk)
+        for _ in range(100):
+            rp.observe(0.1)
+            clk.advance(10.0)          # one slot per observation
+        assert len(rp._ring) <= rp.slots
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            RollingPercentiles(buckets=())
+        with pytest.raises(ValueError):
+            RollingPercentiles(window_s=0)
+        with pytest.raises(ValueError):
+            RollingPercentiles(slots=0)
+
+
+class TestSLOTarget:
+    def test_default_name(self):
+        t = SLOTarget("ttft", 0.5)
+        assert t.name == "ttft_le_0.5" and t.objective == 0.99
+
+    def test_explicit_name_kept(self):
+        assert SLOTarget("ttft", 0.5, name="gold").name == "gold"
+
+    def test_objective_validated(self):
+        with pytest.raises(ValueError):
+            SLOTarget("ttft", 0.5, objective=1.0)
+        with pytest.raises(ValueError):
+            SLOTarget("ttft", 0.5, objective=0.0)
+
+    def test_burn_window_label(self):
+        assert BurnWindow(300.0, 3600.0, 14.4).label == "300s/3600s"
+        assert len(DEFAULT_BURN_WINDOWS) == 2
+
+
+class TestWindowedCounts:
+    def test_rates_respect_lookback(self):
+        clk = FakeClock()
+        wc = _WindowedCounts(slot_s=10.0, max_window_s=100.0, clock=clk)
+        wc.add(False)                  # bad at t=0
+        clk.advance(50.0)
+        wc.add(True)                   # good at t=50
+        assert wc.rates(100.0) == (1, 2)
+        assert wc.rates(20.0) == (0, 1)   # old bad event out of range
+
+    def test_old_slots_dropped(self):
+        clk = FakeClock()
+        wc = _WindowedCounts(slot_s=10.0, max_window_s=30.0, clock=clk)
+        for _ in range(10):
+            wc.add(True)
+            clk.advance(10.0)
+        assert len(wc._ring) <= wc.max_slots
+
+
+def monitor(clk, *, registry=None, objective=0.9):
+    # short window 100s (slot 10s), long 300s; threshold 2x
+    return SLOMonitor(
+        [SLOTarget("ttft", 0.5, objective=objective, name="ttft_slo")],
+        clock=clk, registry=registry,
+        burn_windows=(BurnWindow(100.0, 300.0, 2.0),),
+        slots_per_window=10)
+
+
+class TestSLOMonitor:
+    def test_burn_rate_math(self):
+        clk = FakeClock()
+        mon = monitor(clk, objective=0.9)      # budget = 10% bad
+        for i in range(10):                    # 2 bad of 10 = 20% bad
+            mon.observe("ttft", 1.0 if i < 2 else 0.1)
+        t = mon.targets[0]
+        assert mon.burn_rate(t, 100.0) == pytest.approx(2.0)
+        # no events in window -> 0.0, not NaN
+        clk.advance(1000.0)
+        assert mon.burn_rate(t, 100.0) == 0.0
+
+    def test_untargeted_metric_ignored(self):
+        mon = monitor(FakeClock())
+        mon.observe("queue_wait", 99.0)        # no target -> no-op
+        assert mon.snapshot()["alerts"] == []
+
+    def test_alert_needs_both_windows(self):
+        clk = FakeClock(1000.0)
+        mon = monitor(clk, objective=0.9)
+        # burn only the SHORT window: all-bad burst right now, after a
+        # long good history that keeps the long window under threshold
+        for _ in range(200):
+            mon.observe("ttft", 0.1)
+            clk.advance(1.0)                   # good events, t=1000..1200
+        for _ in range(30):
+            mon.observe("ttft", 9.9)           # bad burst in final slot
+        t = mon.targets[0]
+        assert mon.burn_rate(t, 100.0) > 2.0
+        assert mon.burn_rate(t, 300.0) <= 2.0
+        assert mon.alerts() == []              # long window vetoes
+        # now saturate the long window too -> alert fires
+        for _ in range(300):
+            mon.observe("ttft", 9.9)
+            clk.advance(1.0)
+        alerts = mon.alerts()
+        assert len(alerts) == 1
+        a = alerts[0]
+        assert a["slo"] == "ttft_slo" and a["window"] == "100s/300s"
+        assert a["burn_short"] > 2.0 and a["burn_long"] > 2.0
+
+    def test_duplicate_target_names_rejected(self):
+        with pytest.raises(ValueError, match="duplicate"):
+            SLOMonitor([SLOTarget("a", 1.0, name="x"),
+                        SLOTarget("b", 1.0, name="x")],
+                       clock=FakeClock())
+
+    def test_registry_export(self):
+        clk = FakeClock()
+        reg = MetricsRegistry()
+        mon = monitor(clk, registry=reg)
+        for i in range(4):
+            mon.observe("ttft", 0.1 if i % 2 else 1.0)
+        snap = mon.snapshot()
+        text = reg.prometheus()
+        assert 'slo_events_total{slo="ttft_slo",good="true"} 2' in text
+        assert 'slo_events_total{slo="ttft_slo",good="false"} 2' in text
+        assert 'slo_burn_rate{slo="ttft_slo",window="100s/300s"}' in text
+        assert 'slo_alert{slo="ttft_slo",window="100s/300s"}' in text
+        assert ('slo_latency_quantile{metric="ttft",quantile="p95"}'
+                in text)
+        # snapshot structure
+        wins = snap["targets"]["ttft_slo"]["windows"]["100s/300s"]
+        assert set(wins) == {"burn_short", "burn_long", "threshold",
+                             "firing"}
+        assert snap["percentiles"]["ttft"]["n"] == 4
+        assert snap["percentiles"]["ttft"]["p50"] > 0.0
+
+    def test_snapshot_alert_flags(self):
+        clk = FakeClock()
+        mon = monitor(clk, objective=0.9)
+        for _ in range(50):
+            mon.observe("ttft", 9.9)           # 100% bad -> 10x burn
+        snap = mon.snapshot()
+        win = snap["targets"]["ttft_slo"]["windows"]["100s/300s"]
+        assert win["firing"]
+        assert snap["alerts"] == [("ttft_slo", "100s/300s")]
