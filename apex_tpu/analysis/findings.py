@@ -1,0 +1,147 @@
+"""Structured lint findings and baseline bookkeeping.
+
+A :class:`Finding` is one analyzer hit: a stable rule id
+(``family/name``), a severity, a human message, provenance (the
+``named_scope``/arg path the op came from) and a fix hint.  Findings are
+designed to be DIFFED against a committed baseline file: ``key`` is the
+(rule, scope) pair only — byte counts, shapes and op counts live in
+``details`` so a config tweak that changes sizes does not churn the
+baseline, while a new rule firing in a new place does.
+
+A :class:`LintReport` is one program's lint result (findings + the peak
+memory estimate); :func:`load_baseline`/:func:`save_baseline` persist
+the accepted-finding keys per program, and
+:meth:`LintReport.new_findings` is the CI gate: anything not in the
+baseline fails the run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional, Sequence
+
+SEVERITIES = ("error", "warning", "info")
+_SEV_RANK = {s: i for i, s in enumerate(SEVERITIES)}
+
+
+@dataclasses.dataclass
+class Finding:
+    """One analyzer hit.
+
+    ``rule`` — stable ``family/name`` id (see ``docs/source/analysis.md``
+    for the catalog); ``scope`` — provenance: a ``named_scope`` path for
+    device ops, an ``argN(path)`` string for argument-level rules;
+    ``op`` — the jaxpr primitive or HLO opcode involved; ``fix_hint`` —
+    one actionable sentence; ``details`` — sizes/counts/paths (never part
+    of the baseline key).
+    """
+    rule: str
+    severity: str
+    message: str
+    scope: str = ""
+    op: str = ""
+    fix_hint: str = ""
+    details: Dict = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.severity not in SEVERITIES:
+            raise ValueError(
+                f"severity must be one of {SEVERITIES}, got "
+                f"{self.severity!r}")
+
+    @property
+    def key(self) -> str:
+        """Baseline identity: rule + scope (sizes/counts excluded)."""
+        return f"{self.rule}|{self.scope}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Finding":
+        return cls(**d)
+
+
+@dataclasses.dataclass
+class LintReport:
+    """All findings for one linted program."""
+    program: str
+    findings: List[Finding] = dataclasses.field(default_factory=list)
+    memory: Optional["object"] = None     # analysis.memory.MemoryEstimate
+    analyzers: List[str] = dataclasses.field(default_factory=list)
+    elapsed_s: float = 0.0
+
+    def counts(self) -> Dict[str, int]:
+        out = {s: 0 for s in SEVERITIES}
+        for f in self.findings:
+            out[f.severity] += 1
+        return out
+
+    def sorted_findings(self) -> List[Finding]:
+        return sorted(self.findings,
+                      key=lambda f: (_SEV_RANK[f.severity], f.rule, f.scope))
+
+    def new_findings(self, baseline_keys: Sequence[str]) -> List[Finding]:
+        """Findings not accepted by the baseline (the CI failure set)."""
+        accepted = set(baseline_keys)
+        return [f for f in self.sorted_findings() if f.key not in accepted]
+
+    def to_dict(self) -> dict:
+        mem = None
+        if self.memory is not None:
+            mem = (self.memory.to_dict()
+                   if hasattr(self.memory, "to_dict") else self.memory)
+        return {"program": self.program,
+                "findings": [f.to_dict() for f in self.sorted_findings()],
+                "counts": self.counts(),
+                "memory": mem,
+                "analyzers": list(self.analyzers),
+                "elapsed_s": round(self.elapsed_s, 3)}
+
+    def format_table(self) -> str:
+        """Human-readable per-program table."""
+        lines = [f"== {self.program} "
+                 f"({len(self.findings)} finding(s), "
+                 f"{self.elapsed_s:.2f}s) =="]
+        if not self.findings:
+            lines.append("  clean")
+        for f in self.sorted_findings():
+            lines.append(f"  [{f.severity:<7}] {f.rule:<30} "
+                         f"{f.scope or '-'}")
+            lines.append(f"            {f.message}")
+            if f.fix_hint:
+                lines.append(f"            fix: {f.fix_hint}")
+        if self.memory is not None:
+            lines.append("  " + self.memory.format_summary().replace(
+                "\n", "\n  "))
+        return "\n".join(lines)
+
+
+# -- baseline persistence ----------------------------------------------------
+
+BASELINE_VERSION = 1
+
+
+def save_baseline(path: str, reports: Sequence[LintReport]) -> None:
+    """Write the accepted-findings baseline: per program, the sorted
+    finding keys (rule|scope).  Details are NOT stored — the baseline
+    accepts the finding, not its current byte counts."""
+    data = {"version": BASELINE_VERSION,
+            "programs": {r.program: sorted({f.key for f in r.findings})
+                         for r in reports}}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def load_baseline(path: str) -> Dict[str, List[str]]:
+    """Load ``{program: [finding keys]}``; missing programs lint against
+    an empty accepted set (every finding is new)."""
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"baseline version {data.get('version')!r} != "
+            f"{BASELINE_VERSION} — regenerate with --write-baseline")
+    return {k: list(v) for k, v in data.get("programs", {}).items()}

@@ -1,0 +1,249 @@
+"""A tolerant parser for optimized HLO text.
+
+The compiled module's ``as_text()`` is the one artifact that cannot
+drift from what executes: post-GSPMD, post-fusion, and (on every
+backend this repo targets) SCHEDULED — ``is_scheduled=true`` in the
+module header means the instruction order inside each computation IS
+the execution order, which is what makes text-level liveness analysis
+(:mod:`apex_tpu.analysis.memory`) meaningful.
+
+This is not a full HLO grammar; it extracts exactly what the analyzers
+need per instruction: name, result shape(s) with byte sizes, opcode,
+operand names, called computations, ``sharding``/``replica_groups``
+attributes and the ``op_name`` metadata carrying ``named_scope``
+provenance.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, List, Optional, Tuple
+
+from apex_tpu.observability.comms import _DTYPE_BYTES, _SHAPE_RE
+
+_METADATA_OP_NAME_RE = re.compile(r'op_name="([^"]+)"')
+# one attribute = one computation; branch lists are brace-wrapped.  The
+# two cannot share a comma-continuation regex: `condition=%c, body=%b`
+# would slurp `, body` into the condition's name.
+_CALLED_SINGLE_RE = re.compile(
+    r"(?:to_apply|body|condition|true_computation|false_computation"
+    r"|calls)=\{?%?([\w\.\-]+)\}?")
+_CALLED_MULTI_RE = re.compile(r"branch_computations=\{([^}]*)\}")
+_OPERAND_RE = re.compile(r"%([\w\.\-]+)")
+_SHARDING_RE = re.compile(r"sharding=\{([^}]*)\}")
+_ALIAS_RE = re.compile(r"\{\s*(\d*)\s*\}\s*:\s*\(\s*(\d+)")
+_NUM_PARTITIONS_RE = re.compile(r"num_partitions=(\d+)")
+
+# ops that define no new buffer: views over their operands
+VIEW_OPS = frozenset({"get-tuple-element", "tuple", "bitcast", "parameter"})
+# ops whose body we recurse into for the memory estimate
+CALL_OPS = frozenset({"while", "call", "conditional"})
+# "light" ops a dataflow chain may cross while still counting as the
+# same value (no real compute) — used by the overlap/roundtrip rules
+LIGHT_OPS = frozenset({"convert", "bitcast", "copy", "reshape",
+                       "transpose", "get-tuple-element", "tuple"})
+
+
+def shape_bytes(type_str: str) -> int:
+    """Total bytes of an HLO type string (tuple types sum elements)."""
+    total = 0
+    for dt, dims in _SHAPE_RE.findall(type_str):
+        width = _DTYPE_BYTES.get(dt)
+        if width is None:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * width
+    return total
+
+
+def scope_of(op_name: Optional[str]) -> str:
+    """named_scope provenance from an ``op_name`` metadata string:
+    ``jit(f)/jit(main)/attn/psum`` -> ``attn/psum`` (jit/pjit frames
+    dropped, user scopes and the primitive kept)."""
+    if not op_name:
+        return ""
+    parts = [p for p in op_name.split("/")
+             if not (p.startswith("jit(") or p.startswith("pjit("))]
+    return "/".join(parts)
+
+
+@dataclasses.dataclass
+class Instruction:
+    name: str
+    opcode: str
+    type_str: str
+    nbytes: int
+    operands: List[str]
+    called: List[str]
+    line: str
+    index: int
+    is_root: bool = False
+    is_param: bool = False
+    param_number: Optional[int] = None
+
+    @property
+    def scope(self) -> str:
+        m = _METADATA_OP_NAME_RE.search(self.line)
+        return scope_of(m.group(1) if m else None)
+
+    @property
+    def sharding(self) -> Optional[str]:
+        m = _SHARDING_RE.search(self.line)
+        return m.group(1) if m else None
+
+    @property
+    def replica_group_size(self) -> Optional[int]:
+        m = re.search(r"replica_groups=\{?\{([0-9,]+)\}", self.line)
+        return len(m.group(1).split(",")) if m else None
+
+
+@dataclasses.dataclass
+class Computation:
+    name: str
+    instructions: List[Instruction]
+    is_entry: bool = False
+
+    @property
+    def root(self) -> Instruction:
+        for ins in self.instructions:
+            if ins.is_root:
+                return ins
+        return self.instructions[-1]
+
+    @property
+    def params(self) -> List[Instruction]:
+        return sorted((i for i in self.instructions if i.is_param),
+                      key=lambda i: i.param_number or 0)
+
+    def by_name(self) -> Dict[str, Instruction]:
+        return {i.name: i for i in self.instructions}
+
+
+@dataclasses.dataclass
+class HloModule:
+    header: str
+    computations: Dict[str, Computation]
+    entry: Computation
+
+    @property
+    def input_output_aliases(self) -> List[Tuple[int, int]]:
+        """``(output_index, param_number)`` pairs from the module-level
+        ``input_output_alias`` attribute (donated buffers).  The braces
+        nest (``{ {0}: (0, {}, may-alias) }``), so scan to the balanced
+        close instead of regexing for the first ``}``.  A non-tuple
+        output aliases as ``{}: (...)`` — empty index means output 0."""
+        start = self.header.find("input_output_alias={")
+        if start < 0:
+            return []
+        i = start + len("input_output_alias=")
+        depth = 0
+        for j in range(i, len(self.header)):
+            depth += (self.header[j] == "{") - (self.header[j] == "}")
+            if depth == 0:
+                body = self.header[i + 1:j]
+                break
+        else:
+            return []
+        return [(int(o or 0), int(p))
+                for o, p in _ALIAS_RE.findall(body)]
+
+    @property
+    def num_partitions(self) -> int:
+        m = _NUM_PARTITIONS_RE.search(self.header)
+        return int(m.group(1)) if m else 1
+
+    @property
+    def is_scheduled(self) -> bool:
+        return "is_scheduled=true" in self.header
+
+
+def _parse_type_and_opcode(rhs: str) -> Tuple[str, str, str]:
+    """Split the right-hand side of ``name = <type> <opcode>(...)``.
+    Tuple types contain parens, so match brackets for a leading ``(``."""
+    rhs = rhs.strip()
+    if rhs.startswith("("):
+        depth = 0
+        for i, c in enumerate(rhs):
+            depth += (c == "(") - (c == ")")
+            if depth == 0:
+                type_str = rhs[:i + 1]
+                rest = rhs[i + 1:].strip()
+                break
+        else:                                    # unbalanced: bail
+            type_str, rest = "", rhs
+    else:
+        type_str, _, rest = rhs.partition(" ")
+    m = re.match(r"([\w\-]+)", rest)
+    opcode = m.group(1) if m else ""
+    tail = rest[m.end():] if m else rest
+    return type_str, opcode, tail
+
+
+def parse_hlo_module(text: str) -> HloModule:
+    """Parse ``compiled.as_text()`` into computations + instructions."""
+    lines = text.splitlines()
+    header = lines[0] if lines else ""
+    computations: Dict[str, Computation] = {}
+    entry_name = None
+    current: Optional[Computation] = None
+    for line in lines[1:]:
+        stripped = line.strip()
+        if not stripped or stripped.startswith("//"):
+            continue
+        if stripped.endswith("{") and "=" not in stripped.split("(")[0]:
+            # computation open: `%name (args) -> type {` or `ENTRY %name …`
+            is_entry = stripped.startswith("ENTRY")
+            m = re.search(r"%([\w\.\-]+)", stripped)
+            if not m:
+                continue
+            current = Computation(m.group(1), [], is_entry=is_entry)
+            computations[current.name] = current
+            if is_entry:
+                entry_name = current.name
+            continue
+        if stripped.startswith("}"):
+            current = None
+            continue
+        if current is None or " = " not in stripped:
+            continue
+        lhs, _, rhs = stripped.partition(" = ")
+        is_root = lhs.startswith("ROOT ")
+        name = lhs.replace("ROOT ", "").strip().lstrip("%")
+        type_str, opcode, tail = _parse_type_and_opcode(rhs)
+        if not opcode:
+            continue
+        # operands: %refs in the call parens only (drop attribute refs —
+        # called computations are captured separately)
+        paren = tail.partition("(")[2]
+        depth, end = 1, len(paren)
+        for i, c in enumerate(paren):
+            depth += (c == "(") - (c == ")")
+            if depth == 0:
+                end = i
+                break
+        operands = _OPERAND_RE.findall(paren[:end])
+        attrs = tail[end:] if end < len(tail) else tail
+        called = [m2.group(1)
+                  for m2 in _CALLED_SINGLE_RE.finditer(attrs)]
+        for m2 in _CALLED_MULTI_RE.finditer(attrs):
+            for nm in m2.group(1).split(","):
+                called.append(nm.strip().lstrip("%"))
+        ins = Instruction(
+            name=name, opcode=opcode, type_str=type_str,
+            nbytes=shape_bytes(type_str), operands=operands,
+            called=called, line=stripped,
+            index=len(current.instructions), is_root=is_root,
+            is_param=(opcode == "parameter"))
+        if ins.is_param:
+            pm = re.match(r"\s*(\d+)", tail.partition("(")[2])
+            ins.param_number = int(pm.group(1)) if pm else None
+        current.instructions.append(ins)
+    if entry_name is None:
+        # fall back: last computation
+        entry_name = list(computations)[-1] if computations else ""
+    entry = computations.get(entry_name) or Computation("", [])
+    return HloModule(header=header, computations=computations, entry=entry)

@@ -1,0 +1,196 @@
+"""HLO-level analyzers: sharding lint and collective-overlap audit.
+
+These run on the optimized, scheduled HLO text — after GSPMD has
+propagated shardings and materialized the collectives — because that is
+the only place the questions are answerable: the jaxpr has `psum`, the
+HLO has the actual ``all-reduce`` with its replica groups, its byte
+count, and its position in the schedule.
+
+Rule ids:
+
+* ``sharding/replicated-large`` — a tensor above the size threshold is
+  fully replicated across a partitioned mesh: every device holds the
+  whole thing, the per-device HBM win of sharding it is (n-1)/n.
+* ``sharding/gather-roundtrip`` — a reduce-scatter (or dynamic-slice of
+  a collective result) whose output is immediately all-gathered back to
+  full size: the round trip means GSPMD failed to keep the value
+  sharded between the two ops.
+* ``sharding/large-gather`` — an all-gather materializing a full-size
+  copy above the threshold; often the "replicated weight" pattern in
+  disguise.
+* ``overlap/serialized-collectives`` — collective B's operand chain
+  reaches collective A through LIGHT_OPS only (no compute between
+  them): the pair serializes on the ICI where an async pair would
+  overlap.  The async-collective forms (``all-reduce-start/-done``)
+  already overlap and are skipped.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from apex_tpu.analysis.findings import Finding
+from apex_tpu.analysis.hlo import LIGHT_OPS, HloModule
+
+_COLLECTIVES = frozenset({
+    "all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+    "collective-permute"})
+_GATHERISH = frozenset({"all-gather"})
+_SCATTERISH = frozenset({"reduce-scatter"})
+
+
+def _iter_device_computations(module: HloModule):
+    """Entry + every computation reachable from it (while/call bodies
+    run on device too; collectives inside a pipeline `while` loop are
+    the ones that matter most)."""
+    seen = set()
+    stack = [module.entry.name]
+    while stack:
+        name = stack.pop()
+        if name in seen:
+            continue
+        seen.add(name)
+        comp = module.computations.get(name)
+        if comp is None:
+            continue
+        yield comp
+        for ins in comp.instructions:
+            stack.extend(ins.called)
+
+
+def analyze_sharding(program, config):
+    """Large replicated tensors and gather round-trips."""
+    module = program.hlo_module()
+    nparts = module.num_partitions
+    findings = []
+    if nparts <= 1:
+        return findings     # single device: nothing to shard
+    big = config.large_bytes
+
+    # replicated-large: entry params / outputs carrying an explicit
+    # replicated sharding while the mesh is partitioned
+    seen_repl = set()
+    for ins in module.entry.instructions:
+        sh = ins.sharding
+        if sh is None or "replicated" not in sh:
+            continue
+        if ins.nbytes < big:
+            continue
+        scope = ins.scope or ins.name
+        if scope in seen_repl:
+            continue
+        seen_repl.add(scope)
+        findings.append(Finding(
+            rule="sharding/replicated-large", severity="warning",
+            message=(f"{ins.opcode} `{ins.name}` ({ins.nbytes:,} B) is "
+                     f"fully replicated across {nparts} partitions — "
+                     "every device holds the whole tensor; sharding it "
+                     f"saves {(nparts - 1)}/{nparts} of its HBM per "
+                     "device"),
+            scope=scope, op=ins.opcode,
+            fix_hint=("give the tensor a PartitionSpec over the mesh "
+                      "(or mark it with with_sharding_constraint)"),
+            details={"bytes": ins.nbytes, "partitions": nparts}))
+
+    for comp in _iter_device_computations(module):
+        by_name = comp.by_name()
+        for ins in comp.instructions:
+            if ins.opcode not in _GATHERISH:
+                continue
+            # gather-roundtrip: the gather's operand chain reaches a
+            # reduce-scatter through light ops — sharded then
+            # immediately unsharded
+            frontier = list(ins.operands)
+            for _ in range(16):
+                nxt = []
+                hit = None
+                for op in frontier:
+                    src = by_name.get(op)
+                    if src is None:
+                        continue
+                    if src.opcode in _SCATTERISH:
+                        hit = src
+                        break
+                    if src.opcode in LIGHT_OPS:
+                        nxt.extend(src.operands)
+                if hit is not None or not nxt:
+                    break
+                frontier = nxt
+            if hit is not None:
+                scope = ins.scope or ins.name
+                findings.append(Finding(
+                    rule="sharding/gather-roundtrip", severity="warning",
+                    message=(f"all-gather `{ins.name}` re-materializes "
+                             f"the output of reduce-scatter "
+                             f"`{hit.name}` ({ins.nbytes:,} B) — the "
+                             "value went sharded->full with no compute "
+                             "between, a full ICI round trip"),
+                    scope=scope, op=ins.opcode,
+                    fix_hint=("keep the value sharded between the two "
+                              "ops (with_sharding_constraint) or fuse "
+                              "into a single all-reduce"),
+                    details={"bytes": ins.nbytes,
+                             "scatter": hit.name}))
+                continue
+            if ins.nbytes >= big:
+                scope = ins.scope or ins.name
+                findings.append(Finding(
+                    rule="sharding/large-gather", severity="info",
+                    message=(f"all-gather `{ins.name}` materializes a "
+                             f"full-size {ins.nbytes:,} B copy on every "
+                             "device"),
+                    scope=scope, op=ins.opcode,
+                    fix_hint=("check whether the consumer really needs "
+                              "the unsharded value, or gather just-in-"
+                              "time inside the consuming loop"),
+                    details={"bytes": ins.nbytes}))
+    return findings
+
+
+def analyze_overlap(program, config):
+    """Directly chained (serialized) synchronous collectives."""
+    module = program.hlo_module()
+    findings = []
+    for comp in _iter_device_computations(module):
+        by_name = comp.by_name()
+        for ins in comp.instructions:
+            if ins.opcode not in _COLLECTIVES:
+                continue
+            # walk the operand chain through light ops; stop at the
+            # first real op — if it's another sync collective, the pair
+            # cannot overlap
+            frontier = list(ins.operands)
+            hit = None
+            for _ in range(16):
+                nxt = []
+                for op in frontier:
+                    src = by_name.get(op)
+                    if src is None:
+                        continue
+                    if src.opcode in _COLLECTIVES:
+                        hit = src
+                        break
+                    if src.opcode in LIGHT_OPS:
+                        nxt.extend(src.operands)
+                if hit is not None or not nxt:
+                    break
+                frontier = nxt
+            if hit is None:
+                continue
+            scope = ins.scope or ins.name
+            findings.append(Finding(
+                rule="overlap/serialized-collectives",
+                severity="warning",
+                message=(f"{ins.opcode} `{ins.name}` directly consumes "
+                         f"{hit.opcode} `{hit.name}` with no compute "
+                         "between them — the two collectives serialize "
+                         "on the ICI (combined "
+                         f"{ins.nbytes + hit.nbytes:,} B)"),
+                scope=scope, op=ins.opcode,
+                fix_hint=("fuse them into one collective over the "
+                          "combined axis, or interleave compute so the "
+                          "scheduler can overlap (see "
+                          "observability.comms overlap notes)"),
+                details={"bytes": ins.nbytes, "upstream": hit.name,
+                         "upstream_op": hit.opcode}))
+    return findings

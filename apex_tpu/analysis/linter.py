@@ -1,0 +1,100 @@
+"""The lint driver: analyzer registry, config, and the `lint()` entry.
+
+Usage::
+
+    from apex_tpu.analysis import lint, LintProgram
+
+    report = lint(LintProgram("train_step", fn=step, args=(params, batch),
+                              donate_argnums=(0,)))
+    print(report.format_table())
+
+or, for the common case::
+
+    report = lint_fn(step, params, batch, name="train_step")
+
+Analyzers are plain ``(LintProgram, LintConfig) -> [Finding]``
+functions in a registry keyed by name; jaxpr-level analyzers are
+skipped automatically when the program was built from a prebuilt
+``Lowered``/``Compiled`` (no fn to retrace).  The memory estimator runs
+unless disabled and its result rides on the report.
+
+Linting is compile-only — the program is traced, lowered and compiled
+but never executed, so donated-input programs and collective programs
+lint safely on a single host.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from apex_tpu.analysis.findings import Finding, LintReport
+from apex_tpu.analysis.program import LintProgram
+from apex_tpu.analysis import jaxpr_rules, hlo_rules
+from apex_tpu.analysis.memory import estimate_peak_memory
+
+
+@dataclasses.dataclass
+class LintConfig:
+    """Thresholds and switches shared by all analyzers."""
+    # tensors at or above this are "large" for the sharding rules
+    large_bytes: int = 64 << 20
+    # donation findings below this aliasable-bytes total are dropped
+    # (tiny scalars/counters are not worth donating)
+    donation_min_bytes: int = 1 << 10
+    # analyzer names to run; None = the full registry
+    analyzers: Optional[Sequence[str]] = None
+    # attach the peak-memory estimate (and its XLA cross-check)
+    estimate_memory: bool = True
+
+
+# name -> (needs_jaxpr, analyzer fn)
+ANALYZERS: Dict[str, Tuple[bool, Callable]] = {
+    "dtype": (True, jaxpr_rules.analyze_dtype_promotion),
+    "donation": (True, jaxpr_rules.analyze_donation),
+    "host-sync": (True, jaxpr_rules.analyze_host_sync),
+    "recompile": (True, jaxpr_rules.analyze_recompile),
+    "sharding": (False, hlo_rules.analyze_sharding),
+    "overlap": (False, hlo_rules.analyze_overlap),
+}
+
+
+def lint(program: LintProgram,
+         config: Optional[LintConfig] = None) -> LintReport:
+    """Run every applicable analyzer over one program."""
+    config = config or LintConfig()
+    names = list(config.analyzers) if config.analyzers is not None \
+        else list(ANALYZERS)
+    t0 = time.perf_counter()
+    findings: List[Finding] = []
+    ran: List[str] = []
+    for name in names:
+        if name not in ANALYZERS:
+            raise KeyError(
+                f"unknown analyzer {name!r}; have {sorted(ANALYZERS)}")
+        needs_jaxpr, fn = ANALYZERS[name]
+        if needs_jaxpr and not program.has_jaxpr:
+            continue
+        findings.extend(fn(program, config))
+        ran.append(name)
+    memory = None
+    if config.estimate_memory:
+        memory = estimate_peak_memory(program.get_compiled())
+    return LintReport(
+        program=program.name, findings=findings, memory=memory,
+        analyzers=ran, elapsed_s=time.perf_counter() - t0)
+
+
+def lint_fn(fn: Callable, *args, name: Optional[str] = None,
+            static_argnums: Sequence[int] = (),
+            donate_argnums: Sequence[int] = (),
+            config: Optional[LintConfig] = None,
+            **jit_kwargs) -> LintReport:
+    """Convenience wrapper: lint a jittable fn on example args."""
+    return lint(LintProgram(
+        name=name or getattr(fn, "__name__", "program"),
+        fn=fn, args=args,
+        static_argnums=tuple(static_argnums),
+        donate_argnums=tuple(donate_argnums),
+        jit_kwargs=jit_kwargs), config)

@@ -1,0 +1,106 @@
+"""The artifact bundle the analyzers consume.
+
+One :class:`LintProgram` wraps a jittable fn + example args (or a
+prebuilt ``Lowered``/``Compiled``) and lazily materializes the three
+representations the analyzer registry works over:
+
+* the CLOSED JAXPR (user-level op stream with ``named_scope``
+  provenance on every eqn) — dtype/donation/host-sync rules;
+* the OPTIMIZED, SCHEDULED HLO text — sharding/overlap rules and the
+  memory estimator (post-GSPMD, post-fusion: what actually executes);
+* the COMPILED object — ``memory_analysis()`` cross-checks.
+
+Everything is compile-only: linting never executes the program, so it
+is safe on programs whose donation invalidates inputs and cheap enough
+for a CI gate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+
+def _argnum_paths(args: Sequence, static_argnums: Sequence[int]
+                  ) -> List[Tuple[int, str, Any]]:
+    """Flatten dynamic args to ``(argnum, path, leaf)`` triples, in the
+    order jit traces them (static args skipped)."""
+    import jax
+    static = set(static_argnums)
+    out = []
+    for i, a in enumerate(args):
+        if i in static:
+            continue
+        flat = jax.tree_util.tree_flatten_with_path(a)[0]
+        for path, leaf in flat:
+            out.append((i, jax.tree_util.keystr(path), leaf))
+    return out
+
+
+@dataclasses.dataclass
+class LintProgram:
+    """Lazily-built analyzer inputs for one program."""
+    name: str
+    fn: Optional[Callable] = None
+    args: Sequence = ()
+    static_argnums: Tuple[int, ...] = ()
+    donate_argnums: Tuple[int, ...] = ()
+    jit_kwargs: Dict = dataclasses.field(default_factory=dict)
+    lowered: Any = None            # prebuilt jax Lowered (optional)
+    compiled: Any = None           # prebuilt jax Compiled (optional)
+
+    _jaxpr: Any = dataclasses.field(default=None, repr=False)
+    _hlo_text: Optional[str] = dataclasses.field(default=None, repr=False)
+    _hlo_module: Any = dataclasses.field(default=None, repr=False)
+
+    def __post_init__(self):
+        if self.fn is None and self.lowered is None and \
+                self.compiled is None:
+            raise ValueError("pass fn+args, lowered=, or compiled=")
+        self.static_argnums = tuple(self.static_argnums)
+        self.donate_argnums = tuple(self.donate_argnums)
+
+    # -- jaxpr level ---------------------------------------------------------
+
+    @property
+    def has_jaxpr(self) -> bool:
+        return self.fn is not None
+
+    def closed_jaxpr(self):
+        if self._jaxpr is None:
+            if self.fn is None:
+                raise ValueError(
+                    f"{self.name}: no fn — jaxpr-level analyzers need "
+                    "the (fn, args) form")
+            import jax
+            self._jaxpr = jax.make_jaxpr(
+                self.fn, static_argnums=self.static_argnums)(*self.args)
+        return self._jaxpr
+
+    def arg_leaves(self) -> List[Tuple[int, str, Any]]:
+        return _argnum_paths(self.args, self.static_argnums)
+
+    # -- HLO level -----------------------------------------------------------
+
+    def get_compiled(self):
+        if self.compiled is None:
+            lowered = self.lowered
+            if lowered is None:
+                import jax
+                lowered = jax.jit(
+                    self.fn, static_argnums=self.static_argnums,
+                    donate_argnums=self.donate_argnums,
+                    **self.jit_kwargs).lower(*self.args)
+            self.compiled = lowered.compile()
+        return self.compiled
+
+    def hlo_text(self) -> str:
+        if self._hlo_text is None:
+            self._hlo_text = self.get_compiled().as_text()
+        return self._hlo_text
+
+    def hlo_module(self):
+        if self._hlo_module is None:
+            from apex_tpu.analysis.hlo import parse_hlo_module
+            self._hlo_module = parse_hlo_module(self.hlo_text())
+        return self._hlo_module

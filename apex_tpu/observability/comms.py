@@ -49,6 +49,17 @@ _OP_RE = re.compile(
     + r")(-start)?\(")
 
 _GROUPS_RE = re.compile(r"replica_groups=\{?\{([0-9,]+)\}")
+_OP_NAME_RE = re.compile(r'op_name="([^"]+)"')
+
+
+def _scope_of(op_name: Optional[str]) -> str:
+    """``named_scope`` provenance from ``op_name`` metadata:
+    ``jit(f)/jit(main)/attn/psum`` -> ``attn/psum`` (jit/pjit frames
+    dropped).  Same convention as ``analysis.hlo.scope_of``."""
+    if not op_name:
+        return ""
+    return "/".join(p for p in op_name.split("/")
+                    if not (p.startswith("jit(") or p.startswith("pjit(")))
 
 
 def _shape_bytes(dtype: str, dims: str) -> Optional[int]:
@@ -68,7 +79,9 @@ def hlo_collective_stats(hlo_text: str) -> Dict[str, dict]:
     Returns ``{kind: {"count": int, "bytes": int, "ops": [...]}}`` plus
     a ``"total"`` row.  ``bytes`` is payload bytes per single execution
     of the program; ``ops`` lists each instruction's
-    ``(bytes, group_size)`` for finer-grained reports.
+    ``{"bytes", "group_size", "scope"}`` — ``scope`` is the
+    ``named_scope`` path from the instruction's ``op_name`` metadata, so
+    a byte total traces back to the model code that issued it.
     """
     out: Dict[str, dict] = {
         k.replace("-", "_"): {"count": 0, "bytes": 0, "ops": []}
@@ -85,9 +98,12 @@ def hlo_collective_stats(hlo_text: str) -> Dict[str, dict]:
         nbytes = max(sizes, default=0)
         g = _GROUPS_RE.search(line)
         group = len(g.group(1).split(",")) if g else None
+        nm = _OP_NAME_RE.search(line)
         out[kind]["count"] += 1
         out[kind]["bytes"] += nbytes
-        out[kind]["ops"].append({"bytes": nbytes, "group_size": group})
+        out[kind]["ops"].append({"bytes": nbytes, "group_size": group,
+                                 "scope": _scope_of(nm.group(1)
+                                                    if nm else None)})
     out["total"] = {
         "count": sum(v["count"] for v in out.values()),
         "bytes": sum(v["bytes"] for v in out.values()),
@@ -129,8 +145,11 @@ def wire_bytes(stats: Dict[str, dict]) -> int:
     return int(total)
 
 
-def format_stats(stats: Dict[str, dict]) -> str:
-    """Human-readable table of a :func:`hlo_collective_stats` result."""
+def format_stats(stats: Dict[str, dict], *,
+                 by_scope: bool = False) -> str:
+    """Human-readable table of a :func:`hlo_collective_stats` result.
+    ``by_scope=True`` appends a per-``named_scope`` breakdown under each
+    kind, attributing bytes back to the issuing model code."""
     lines = [f"{'collective':<20} {'count':>5} {'payload bytes':>14}"]
     for kind in sorted(stats):
         if kind == "total":
@@ -139,6 +158,15 @@ def format_stats(stats: Dict[str, dict]) -> str:
         if row["count"]:
             lines.append(f"{kind:<20} {row['count']:>5} "
                          f"{row['bytes']:>14,}")
+            if by_scope:
+                per: Dict[str, tuple] = {}
+                for op in row.get("ops", ()):
+                    s = op.get("scope") or "<no scope>"
+                    c, b = per.get(s, (0, 0))
+                    per[s] = (c + 1, b + op["bytes"])
+                for s, (c, b) in sorted(per.items(),
+                                        key=lambda kv: -kv[1][1]):
+                    lines.append(f"  {s:<18} {c:>5} {b:>14,}")
     t = stats.get("total", {})
     lines.append(f"{'total':<20} {t.get('count', 0):>5} "
                  f"{t.get('bytes', 0):>14,} "

@@ -97,7 +97,7 @@ class GuardedTrainStep:
                  scaler=None, spike_factor: float = 10.0,
                  ema_decay: float = 0.99, warmup_steps: int = 5,
                  max_consecutive: int = 3, checkpoint=None,
-                 fault_injector=None, lr=None):
+                 fault_injector=None, lr=None, donate: bool = False):
         if (loss_fn is None) == (grad_fn is None):
             raise ValueError("pass exactly one of loss_fn / grad_fn")
         if optimizer is None:
@@ -117,15 +117,26 @@ class GuardedTrainStep:
         self.checkpoint = checkpoint
         self.fault_injector = fault_injector
         self.lr = lr
-        self._compiled = jax.jit(self._raw_step)
+        self.donate = bool(donate)
+        # donate the full train state (params, opt, guard, scaler): the
+        # update is in-place, halving the state's HBM across the step.
+        # Opt-in because the caller's input buffers die — safe with the
+        # standard drive loop (it only keeps the returned state; the
+        # rollback template reads shape/dtype metadata, which survives
+        # donation), unsafe for callers that re-read the old state
+        self._compiled = jax.jit(
+            self._raw_step,
+            donate_argnums=(0, 1, 2, 3) if self.donate else ())
         self._consecutive = 0
         self._last_sstate = None
         self.counters = {"steps": 0, "skipped": 0, "nonfinite": 0,
                          "spikes": 0, "rollbacks": 0}
 
     def init_state(self) -> GuardState:
-        z32 = jnp.zeros((), jnp.int32)
-        return GuardState(jnp.zeros((), _f32), z32, z32, z32, z32, z32)
+        # one array PER field: donate=True donates this tree, and XLA
+        # rejects the same buffer appearing twice in a donated argument
+        return GuardState(jnp.zeros((), _f32),
+                          *(jnp.zeros((), jnp.int32) for _ in range(5)))
 
     # -- the jitted step -----------------------------------------------------
 

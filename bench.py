@@ -1268,6 +1268,46 @@ def bench_serving_observability():
             "trace_ok": bool(trace_ok)}
 
 
+def bench_lint():
+    """Static-analysis leg (ISSUE 8): time the lint gate itself.
+
+    Linting is compile-only and the gate is meant to ride in CI, so the
+    metric is wall time per canonical program (<10 s each) plus the
+    baseline diff.  The linter needs a multi-device CPU mesh (the
+    canonical programs span dp/tp/pp), and this process owns the TPU —
+    so drive ``tools/lint_graph.py`` in a subprocess pinned to the host
+    platform, exactly as CI runs it."""
+    import subprocess
+    import sys
+
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools", "lint_graph.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)       # lint_graph sets its own device count
+    t0 = time.perf_counter()
+    out = subprocess.run([sys.executable, script, "--json"],
+                         capture_output=True, text=True, env=env,
+                         timeout=600)
+    wall = time.perf_counter() - t0
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"lint gate failed (exit {out.returncode}): "
+            f"{out.stderr[-1500:]}")
+    doc = json.loads(out.stdout)
+    per_program = {p["program"]: p["elapsed_s"] for p in doc["programs"]}
+    slowest = max(per_program.values()) if per_program else 0.0
+    return {"programs": len(per_program),
+            "findings": sum(len(p["findings"]) for p in doc["programs"]),
+            "new_findings": sum(len(v) for v in
+                                doc.get("new_findings", {}).values()),
+            "per_program_s": {k: round(v, 3)
+                              for k, v in per_program.items()},
+            "slowest_program_s": round(slowest, 3),
+            "per_program_target_s": 10.0,
+            "per_program_ok": bool(slowest < 10.0),
+            "total_wall_s": round(wall, 3)}
+
+
 def main():
     backend = jax.default_backend()
     # every leg's result also lands on the metrics registry as one
@@ -1298,6 +1338,7 @@ def main():
     resilience = _retry(bench_resilience)
     observability = _retry(bench_observability)
     serving_obs = _retry(bench_serving_observability)
+    lint_gate = _retry(bench_lint)
     rounded = lambda d: (None if d is None else
                          {k: (round(v, 6) if isinstance(v, float) else v)
                           for k, v in d.items()})
@@ -1325,6 +1366,7 @@ def main():
             "resilience": resilience,
             "observability": rounded(observability),
             "serving_observability": rounded(serving_obs),
+            "lint": lint_gate,
         },
     }
     result["metrics_stream"] = stream_path
