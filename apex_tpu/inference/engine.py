@@ -88,8 +88,11 @@ class Response:
     ids (including the eos token when one was emitted);
     ``finish_reason`` is ``"eos"``, ``"length"`` (max_new_tokens or
     cache row exhausted), ``"evicted"`` (deadline), ``"timeout"``
-    (per-request budget) or ``"error"`` (poison request quarantined —
-    ``error`` carries the exception message)."""
+    (per-request budget), ``"error"`` (poison request quarantined —
+    ``error`` carries the exception message) or ``"preempted"`` (the
+    engine was preempted and this request could not be requeued —
+    :meth:`InferenceEngine.preempt` requeues whenever resume is
+    possible, so this is the exception, not the rule)."""
     request_id: int
     prompt: List[int]
     tokens: List[int]
@@ -140,6 +143,8 @@ class InferenceEngine:
         self._queue: collections.deque = collections.deque()
         self._active: dict = {}          # slot -> _Active
         self._submit_time: dict = {}     # request_id -> submit clock value
+        self._progress: dict = {}        # request_id -> tokens generated
+                                         # before a preemption requeue
         self._done: List[Response] = []
         # the cache buffer threads through every step: donate it so XLA
         # updates it in place — without donation every decode step holds
@@ -224,6 +229,7 @@ class InferenceEngine:
         """Common completion tail for active AND still-queued requests:
         metrics dispatch + the Response record."""
         self._submit_time.pop(req.request_id, None)
+        self._progress.pop(req.request_id, None)
         if reason == "evicted":
             self.metrics.request_evicted(req.request_id)
         elif reason == "timeout":
@@ -275,33 +281,77 @@ class InferenceEngine:
             req = self._queue.popleft()
             reason = expired(req)
             if reason:
-                self._finish_response(req, [], reason)
+                # a requeued request keeps its partial progress in the
+                # terminal Response
+                done = self._progress.get(req.request_id, [])
+                self._finish_response(req, list(done), reason)
             else:
                 keep.append(req)
         self._queue = keep
+
+    def preempt(self) -> int:
+        """Drain on preemption: requeue every in-flight request instead
+        of dropping it.  Each active request's slot is freed, its
+        generated-so-far tokens are stashed, and the request goes back
+        to the FRONT of the queue (lowest slot first — nearest to done,
+        first re-admitted); the next :meth:`_admit` re-prefills prompt +
+        generated and resumes the per-request sampling stream at the
+        token index it stopped at, so greedy (and seeded stochastic)
+        outputs are unchanged by the interruption.  Timeout budgets keep
+        running across the requeue (the interruption is the server's
+        fault, but the deadline semantics are the client's).  Returns
+        the number of requests requeued.  A request whose context no
+        longer fits a cache row finishes with ``reason="preempted"``
+        instead.
+        """
+        requeued = 0
+        for slot in sorted(self._active, reverse=True):
+            st = self._active[slot]
+            req = st.request
+            if len(req.prompt) + len(st.generated) >= self.cache.max_seq:
+                self._finish(slot, st, "preempted")
+                continue
+            self.cache.free(slot)
+            del self._active[slot]
+            self._progress[req.request_id] = list(st.generated)
+            self.metrics.request_requeued(req.request_id)
+            self.trace.requeue(req.request_id)
+            self._queue.appendleft(req)
+            requeued += 1
+        return requeued
 
     def _admit(self) -> None:
         while self._queue and self.cache.free_slots:
             req = self._queue.popleft()
             slot = self.cache.allocate()
-            self.trace.admit(req.request_id)
+            prev = self._progress.pop(req.request_id, None)
+            if prev is None:
+                self.trace.admit(req.request_id)
             try:
                 plen = len(req.prompt)
-                toks = np.zeros((1, self._bucket(plen)), np.int32)
-                toks[0, :plen] = req.prompt
+                ctx = list(req.prompt) + (prev or [])
+                clen = len(ctx)
+                toks = np.zeros((1, self._bucket(clen)), np.int32)
+                toks[0, :clen] = ctx
                 logits, kv = self._prefill(self.params, jnp.asarray(toks))
-                self.cache.write_prompt(slot, kv[:, :, 0], plen)
-                first = self._sample(req, np.asarray(logits[0, plen - 1]),
-                                     0)
+                self.cache.write_prompt(slot, kv[:, :, 0], clen)
+                nxt = self._sample(req, np.asarray(logits[0, clen - 1]),
+                                   len(prev or []))
             except Exception as e:          # quarantine: free the slot,
                 self.cache.free(slot)       # fail ONE request, keep going
-                self._finish_response(req, [], "error",
+                self._finish_response(req, list(prev or []), "error",
                                       error=f"{type(e).__name__}: {e}")
                 continue
-            self.metrics.first_token(req.request_id)
-            self.trace.first_token(req.request_id)
-            st = _Active(req, plen, next_token=first, position=plen,
-                         generated=[first])
+            if prev is None:
+                self.metrics.first_token(req.request_id)
+                self.trace.first_token(req.request_id)
+            else:
+                # a resumed request's TTFT already happened; the token
+                # re-enters the throughput series only
+                self.metrics.token(req.request_id)
+                self.trace.decode_tick(req.request_id)
+            st = _Active(req, plen, next_token=nxt, position=clen,
+                         generated=(prev or []) + [nxt])
             self._active[slot] = st
             self._maybe_finish(slot, st)
 

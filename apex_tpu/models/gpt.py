@@ -897,6 +897,79 @@ def pack_for_shard_map(model: GPTModel, params, n_stages: Optional[int] = None,
     return packed, in_specs, local_fn, repack_fn
 
 
+def unpack_from_shard_map(model: GPTModel, packed,
+                          n_stages: Optional[int] = None,
+                          n_virtual: int = 1):
+    """Inverse of :func:`pack_for_shard_map`: recover the serial-init
+    param layout from a packed tree.
+
+    TP-stacked leaves are concatenated back along their sharded dim
+    (per :meth:`GPTModel.partition_specs` — the same specs that drove
+    the packing), stage stacks are un-interleaved and flattened back to
+    the per-layer list.  Pure slicing/concat, so f32 values round-trip
+    bitwise — which is what makes the serial layout the canonical form
+    elastic re-sharding compares topologies in (a ``dp=2 x tp=2``
+    state and a ``dp=4`` state unpack to the SAME logical tensors).
+    Expert-parallel packings are not invertible here (the ep split
+    interleaves expert rows); unpack before applying ``expert_axis``.
+    """
+    cfg = model.cfg
+    if cfg.n_experts > 0:
+        raise ValueError(
+            "unpack_from_shard_map does not support expert-parallel "
+            "packings; unpack applies to dense GPT params only")
+    specs = model.partition_specs()
+
+    def shard_dim(s):
+        for d, a in enumerate(s):
+            if a is not None:
+                return d
+        return None
+
+    def merge_plain(s, x):
+        d = shard_dim(s)
+        if d is None:
+            return x
+        return jnp.concatenate([x[r] for r in range(x.shape[0])], axis=d)
+
+    def unstack_layers(s, x):
+        d = shard_dim(s)
+        parts = ([x[r] for r in range(x.shape[0])] if d is not None
+                 else [x])
+        flat_parts = []
+        for y in parts:
+            if n_virtual == 1:
+                flat = y.reshape((n_stages * y.shape[1],) + y.shape[2:])
+            else:
+                n_logical = n_stages * n_virtual
+                lpc = y.shape[2]
+                z = y.reshape((n_logical, lpc) + y.shape[3:])
+                perm = [c * n_stages + st for st in range(n_stages)
+                        for c in range(n_virtual)]
+                inv = jnp.asarray([perm.index(i)
+                                   for i in range(n_logical)])
+                flat = z[inv].reshape((n_logical * lpc,) + z.shape[2:])
+            flat_parts.append(flat)
+        # the per-layer sharded dim sits behind the layer axis now
+        return (flat_parts[0] if d is None
+                else jnp.concatenate(flat_parts, axis=d + 1))
+
+    out = {}
+    for key, sub in packed.items():
+        if key == "layers" and n_stages is not None:
+            merged = jax.tree_util.tree_map(
+                unstack_layers, specs["layers"][0], sub,
+                is_leaf=_is_spec_leaf)
+            n_layers = jax.tree_util.tree_leaves(merged)[0].shape[0]
+            out[key] = [jax.tree_util.tree_map(
+                lambda leaf, i=i: leaf[i], merged)
+                for i in range(n_layers)]
+        else:
+            out[key] = jax.tree_util.tree_map(
+                merge_plain, specs[key], sub, is_leaf=_is_spec_leaf)
+    return out
+
+
 # -- pipeline composition ----------------------------------------------------
 
 def stack_layers_for_pipeline(layer_params, n_stages: int,

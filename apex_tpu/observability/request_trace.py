@@ -120,6 +120,14 @@ class RequestTracer:
         if st is not None:
             st.ticks += 1
 
+    def requeue(self, request_id) -> None:
+        """A preemption sent this in-flight request back to the queue.
+        The live entry stays open (the request's lifecycle continues
+        through re-admission — phase timestamps keep accumulating into
+        the SAME record), so this only marks the event on the timeline."""
+        if self.tracer is not None:
+            self.tracer.instant("request_requeued", request_id=request_id)
+
     @property
     def pending(self) -> int:
         """Requests enqueued but not yet finished (leak sentinel)."""

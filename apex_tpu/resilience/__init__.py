@@ -1,6 +1,6 @@
-"""Fault tolerance for production training and serving (ISSUE 4).
+"""Fault tolerance for production training and serving (ISSUE 4, 9).
 
-Three layers, composable but independently usable:
+Four layers, composable but independently usable:
 
 * :mod:`~apex_tpu.resilience.checkpoint` — atomic, content-hashed,
   shard-aware checkpointing with a ``latest``-symlink commit protocol
@@ -12,13 +12,25 @@ Three layers, composable but independently usable:
 * :mod:`~apex_tpu.resilience.faults` — :class:`FaultInjector`, a
   deterministic seeded fault schedule (``nan_grads``, ``inf_loss``,
   ``grad_spike``, ``preempt_at_step``, ``corrupt_checkpoint``,
-  ``slow_host``) threaded through the train loop and checkpoint IO so
-  every recovery path is exercised by tests and
+  ``slow_host``, ``topology_change``) threaded through the train loop
+  and checkpoint IO so every recovery path is exercised by tests and
   ``tools/crash_matrix.py``.
+* :mod:`~apex_tpu.resilience.elastic` — preemption-native elastic
+  training: :class:`TopologySpec`/:class:`ElasticPlan` layout
+  descriptors (stamped into checkpoint manifests),
+  :func:`reshard_optimizer_state` (ZeRO gather-to-logical → re-split
+  and per-leaf slot re-layout across dp/tp/pp changes, f32 bitwise),
+  and :class:`ElasticTrainer`, the signal-driven drain → checkpoint →
+  re-plan → re-shard → resume loop around :class:`GuardedTrainStep`.
 """
 
 from apex_tpu.resilience.checkpoint import (CheckpointManager,
                                             CheckpointNotFound)
+from apex_tpu.resilience.elastic import (ElasticComponents, ElasticPlan,
+                                         ElasticSignal, ElasticTrainer,
+                                         HostSignals, TopologySpec,
+                                         ZeROGuardAdapter,
+                                         reshard_optimizer_state)
 from apex_tpu.resilience.faults import (FAULT_KINDS, Fault, FaultInjector,
                                         Preemption)
 from apex_tpu.resilience.guard import (GuardedTrainStep, GuardState,
@@ -27,11 +39,19 @@ from apex_tpu.resilience.guard import (GuardedTrainStep, GuardState,
 __all__ = [
     "CheckpointManager",
     "CheckpointNotFound",
+    "ElasticComponents",
+    "ElasticPlan",
+    "ElasticSignal",
+    "ElasticTrainer",
     "FAULT_KINDS",
     "Fault",
     "FaultInjector",
+    "HostSignals",
     "Preemption",
     "GuardedTrainStep",
     "GuardState",
     "StepResult",
+    "TopologySpec",
+    "ZeROGuardAdapter",
+    "reshard_optimizer_state",
 ]

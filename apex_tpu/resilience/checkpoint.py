@@ -115,19 +115,32 @@ class CheckpointManager:
     ``corrupt_checkpoint`` at the saved step flips payload bytes AFTER
     the commit, producing exactly the torn write the hash check must
     catch.
+
+    ``topology`` (a :class:`~apex_tpu.resilience.elastic.TopologySpec`
+    or its dict form; mutable — the elastic trainer updates it on every
+    re-plan) is stamped into each manifest together with the mesh
+    shape, so a restart can tell which layout a checkpoint's arrays are
+    partitioned for BEFORE deserializing them into the wrong one.
     """
 
     def __init__(self, directory: str, *, keep: int = 2, threads: int = 4,
-                 fault_injector=None):
+                 fault_injector=None, topology=None):
         if keep < 1:
             raise ValueError("keep must be >= 1")
         self.directory = str(directory)
         self.keep = int(keep)
         self.threads = int(threads)
         self.fault_injector = fault_injector
+        self.topology = topology
         os.makedirs(self.directory, exist_ok=True)
         self._pending: list = []          # [(step, thread, box)]
         self._lock = threading.Lock()
+
+    def _topology_dict(self) -> Optional[dict]:
+        t = self.topology
+        if t is None:
+            return None
+        return t.to_dict() if hasattr(t, "to_dict") else dict(t)
 
     # -- enumeration --------------------------------------------------------
 
@@ -145,6 +158,17 @@ class CheckpointManager:
     def latest_step(self) -> Optional[int]:
         steps = self.all_steps()
         return steps[-1] if steps else None
+
+    def topology_of(self, step: int) -> Optional[dict]:
+        """The topology dict stamped into ``step``'s manifest (``None``
+        for checkpoints saved without one) — manifest-only, no payload
+        read, so a restart can pick its restore layout cheaply."""
+        mpath = os.path.join(self.directory, _step_dirname(step), _MANIFEST)
+        try:
+            with open(mpath) as f:
+                return json.load(f).get("topology")
+        except (OSError, ValueError):
+            return None
 
     # -- save ---------------------------------------------------------------
 
@@ -176,6 +200,12 @@ class CheckpointManager:
         manifest = {"format": _FORMAT, "step": int(step),
                     "sha256": digest, "nbytes": int(payload.nbytes),
                     "treedef": str(treedef), "leaves": recs}
+        topo = self._topology_dict()
+        if topo is not None:
+            manifest["topology"] = topo
+            manifest["mesh_shape"] = {"data": topo.get("dp", 1),
+                                      "pipe": topo.get("pp", 1),
+                                      "model": topo.get("tp", 1)}
 
         final = os.path.join(self.directory, _step_dirname(step))
         tmp = final + ".tmp"
@@ -255,7 +285,7 @@ class CheckpointManager:
     # -- restore ------------------------------------------------------------
 
     def restore(self, template, *, step: Optional[int] = None,
-                shardings=None) -> Tuple[Any, int]:
+                shardings=None, topology=None) -> Tuple[Any, int]:
         """Load the newest complete, hash-valid checkpoint.
 
         ``template`` supplies the pytree structure and (via its leaves'
@@ -263,9 +293,16 @@ class CheckpointManager:
         mesh/topology than the save is just a different template.
         ``shardings``, when given, is a matching pytree overriding the
         per-leaf placement.  ``step`` pins a specific checkpoint instead
-        of the newest.  Returns ``(state, step)``; raises
-        :class:`CheckpointNotFound` when no valid candidate survives the
-        hash check.
+        of the newest.  ``topology`` declares the layout the caller is
+        restoring INTO (:class:`~apex_tpu.resilience.elastic.
+        TopologySpec` or dict); when it differs from the manifest's
+        stamped topology a warning names BOTH specs — the state is
+        still loaded (templates define placement), but the caller is on
+        notice that :func:`~apex_tpu.resilience.elastic.
+        reshard_optimizer_state` must run before any layout-dependent
+        state (ZeRO buckets) is usable.  Returns ``(state, step)``;
+        raises :class:`CheckpointNotFound` when no valid candidate
+        survives the hash check.
         """
         import jax
 
@@ -274,7 +311,7 @@ class CheckpointManager:
         for s in candidates:
             path = os.path.join(self.directory, _step_dirname(s))
             try:
-                leaves = self._load_dir(path)
+                leaves, manifest = self._load_dir(path)
             except (OSError, ValueError, KeyError) as e:
                 warnings.warn(
                     f"checkpoint {path} is corrupt or torn ({e}); "
@@ -287,6 +324,17 @@ class CheckpointManager:
                     f"checkpoint {path} has {len(leaves)} leaves but the "
                     f"template has {len(t_leaves)}; skipping", stacklevel=2)
                 continue
+            if topology is not None:
+                want = (topology.to_dict() if hasattr(topology, "to_dict")
+                        else dict(topology))
+                saved = manifest.get("topology")
+                if saved is not None and saved != want:
+                    warnings.warn(
+                        f"checkpoint {path} was saved under topology "
+                        f"{saved} but is being restored onto {want}; "
+                        "optimizer state must be re-sharded "
+                        "(reshard_optimizer_state) before use",
+                        stacklevel=2)
             s_leaves = (None if shardings is None
                         else jax.tree_util.tree_leaves(shardings))
             out = []
@@ -305,7 +353,7 @@ class CheckpointManager:
             f"no complete checkpoint under {self.directory!r} "
             f"(candidates tried: {candidates})")
 
-    def _load_dir(self, path: str) -> List[np.ndarray]:
+    def _load_dir(self, path: str) -> Tuple[List[np.ndarray], dict]:
         with open(os.path.join(path, _MANIFEST)) as f:
             manifest = json.load(f)
         payload = native.file_read(os.path.join(path, _PAYLOAD),
@@ -329,7 +377,7 @@ class CheckpointManager:
                 part = payload[sh["offset"]:sh["offset"] + n].view(dt)
                 full[sl] = part.reshape(full[sl].shape)
             leaves.append(full)
-        return leaves
+        return leaves, manifest
 
 
 def _corrupt_payload(path: str, n: int = 64) -> None:

@@ -16,6 +16,10 @@ same failure sequence on every run.  Kinds:
                     hash must catch)
 ``slow_host``       the host sleeps ``magnitude`` seconds before the step
                     (straggler simulation; surfaced in step timings)
+``topology_change`` the pod shrinks/grows at that step: ``magnitude`` > 0
+                    names the new dp degree, 0 asks the elastic trainer to
+                    toggle shrink-to-half / grow-back; consumed via
+                    :meth:`FaultInjector.check_topology_change`
 =================== =========================================================
 
 The in-jit kinds are injected as DATA, not control flow:
@@ -33,7 +37,7 @@ from typing import Dict, Iterable, List, Optional, Tuple
 import numpy as np
 
 FAULT_KINDS = ("nan_grads", "inf_loss", "grad_spike", "preempt_at_step",
-               "corrupt_checkpoint", "slow_host")
+               "corrupt_checkpoint", "slow_host", "topology_change")
 
 
 class Preemption(RuntimeError):
@@ -50,7 +54,8 @@ class Preemption(RuntimeError):
 @dataclasses.dataclass(frozen=True)
 class Fault:
     """One scheduled fault.  ``magnitude`` is the spike factor for
-    ``grad_spike`` and the sleep seconds for ``slow_host``."""
+    ``grad_spike``, the sleep seconds for ``slow_host``, and the target
+    dp degree for ``topology_change`` (0 = auto shrink/grow toggle)."""
     step: int
     kind: str
     magnitude: float = 0.0
@@ -138,6 +143,16 @@ class FaultInjector:
         if self._find(step, "preempt_at_step"):
             self.record(step, "preempt_at_step")
             raise Preemption(step)
+
+    def check_topology_change(self, step: int) -> Optional[Fault]:
+        """The scheduled ``topology_change`` at ``step``, if any —
+        recorded on consumption; the elastic trainer turns it into a
+        re-plan BEFORE the step runs (the step executes on the new
+        topology, matching a maintenance event's grace window)."""
+        f = self._find(step, "topology_change")
+        if f is not None:
+            self.record(step, "topology_change")
+        return f
 
     def maybe_slow_host(self, step: int) -> None:
         f = self._find(step, "slow_host")

@@ -219,6 +219,7 @@ class ServingMetrics:
         self.evicted = 0                 # deadline evictions (active+queued)
         self.errors = 0                  # poison requests quarantined
         self.timeouts = 0                # per-request timeout expiries
+        self.requeued = 0                # preemption requeues (non-terminal)
         self._started: float | None = None
         r = self.registry
         self._c_requests = r.counter("serving_requests_total",
@@ -243,6 +244,9 @@ class ServingMetrics:
             "serving_decode_ticks",
             "decode ticks per request (from the request trace)",
             buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024))
+        self._c_requeued = r.counter(
+            "serving_requests_requeued_total",
+            "in-flight requests requeued by an engine preemption")
 
     def request_submitted(self, request_id) -> None:
         self._submitted[request_id] = self.clock()
@@ -327,6 +331,14 @@ class ServingMetrics:
         self.timeouts += 1
         self._terminal(request_id, "timeout")
 
+    def request_requeued(self, request_id) -> None:
+        """An in-flight request was requeued by an engine preemption.
+        NON-terminal: the request's transient state (TTFT bookkeeping,
+        token-latency chain) survives — it will be re-admitted and its
+        next token lands in the same per-request series."""
+        self.requeued += 1
+        self._c_requeued.inc()
+
     @property
     def pending_requests(self) -> int:
         """Requests submitted but not yet terminal (leak sentinel:
@@ -351,6 +363,7 @@ class ServingMetrics:
             "evicted": self.evicted,
             "errors": self.errors,
             "timeouts": self.timeouts,
+            "requeued": self.requeued,
             "tokens_per_s": (self.tokens_emitted / elapsed
                              if elapsed > 0 else 0.0),
             "ttft_p50_s": self._pct(list(self.ttft.values()), 0.5),

@@ -1104,6 +1104,92 @@ def bench_resilience():
             "guard_overhead_ok": bool(overhead < 0.02)}
 
 
+def bench_elastic():
+    """Elastic leg (ISSUE 9): what a topology re-plan costs.
+
+    An :class:`ElasticTrainer` runs a guarded FusedAdam loop and is
+    asked — through the :class:`HostSignals` mailbox, the SIGTERM
+    route — to shrink dp to half and later grow back.  Each re-plan
+    decomposes into the trainer's own phase stats (``checkpoint_s``:
+    drain + boundary save, ``reshard_s``: rebuild + re-partition +
+    post-reshard save) plus a measured ``recompile_s``: the first step
+    under the new topology minus the steady-state median step (XLA
+    retraces because the mesh changed).  ``total_recovery_s`` is the
+    sum — the wall time a preempted pod spends not training.  Runs on
+    any device count (dp=1 -> dp=1 still exercises the full
+    drain/checkpoint/reshard/recompile cycle)."""
+    import shutil
+    import tempfile
+
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.resilience import (ElasticComponents, ElasticPlan,
+                                     ElasticTrainer, GuardedTrainStep,
+                                     HostSignals, TopologySpec)
+
+    _free_calibration()
+    n = len(jax.devices())
+    dp = 4 if n >= 4 else (2 if n >= 2 else 1)
+    base = TopologySpec(dp=dp)
+    shrink = TopologySpec(dp=max(1, dp // 2))
+
+    def loss_fn(p, x, y):
+        return jnp.mean(jnp.square(x @ p["w"] + p["b"] - y))
+
+    def factory(plan, ckpt, inj):
+        opt = FusedAdam(lr=1e-3, bucketed=False)
+        guard = GuardedTrainStep(loss_fn, opt, warmup_steps=1,
+                                 checkpoint=ckpt, fault_injector=inj)
+        r = np.random.RandomState(7)
+        params = plan.put(
+            {"w": jnp.asarray((r.randn(512, 256) * 0.02).astype(np.float32)),
+             "b": jnp.zeros((256,), jnp.float32)})
+        return ElasticComponents(guard, params, opt.init(params),
+                                 guard.init_state())
+
+    n_steps = 10
+    signals = HostSignals()
+    stamps = {}
+
+    def batch_fn(step, plan):
+        # one timestamp per executed step: gap s -> s+1 is the cost of
+        # executing step s (+ the re-plan when one precedes step s+1)
+        stamps.setdefault(step, time.perf_counter())
+        if step == 3:
+            signals.request_replan(shrink)
+        elif step == 6:
+            signals.request_replan(base)
+        r = np.random.RandomState(9_000 + step)
+        return (jnp.asarray(r.randn(64, 512).astype(np.float32)),
+                jnp.asarray(r.randn(64, 256).astype(np.float32)))
+
+    root = tempfile.mkdtemp(prefix="apex_tpu_bench_elastic_")
+    try:
+        trainer = ElasticTrainer(
+            factory, ElasticPlan.build(base), directory=root,
+            signals=signals)
+        out = trainer.train(batch_fn, n_steps)
+        stamps[n_steps] = time.perf_counter()
+        assert out["replans"] == 2, out
+        gap = {s: stamps[s + 1] - stamps[s] for s in range(n_steps)}
+        # signals requested at steps 3/6 land at the NEXT poll, so the
+        # re-plans precede steps 4 and 7: gaps 3 and 6 absorb the
+        # re-plan, gaps 4 and 7 absorb the recompile
+        steady = float(np.median([gap[s] for s in (1, 2, 5, 8)]))
+        recompile = max(0.0,
+                        float(np.median([gap[4], gap[7]])) - steady)
+        ck = trainer.stats["last_checkpoint_s"]
+        rs = trainer.stats["last_reshard_s"]
+        return {"dp": dp, "shrink_dp": shrink.dp,
+                "replans": out["replans"],
+                "steady_step_s": round(steady, 5),
+                "checkpoint_s": round(ck, 5),
+                "reshard_s": round(rs, 5),
+                "recompile_s": round(recompile, 5),
+                "total_recovery_s": round(ck + rs + recompile, 5)}
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def bench_observability():
     """Observability leg (ISSUE 5): what monitoring costs.
 
@@ -1336,6 +1422,7 @@ def main():
     tp_overlap = _retry(bench_tp_overlap)
     pp_schedules = _retry(bench_pp_schedules)
     resilience = _retry(bench_resilience)
+    elastic = _retry(bench_elastic)
     observability = _retry(bench_observability)
     serving_obs = _retry(bench_serving_observability)
     lint_gate = _retry(bench_lint)
@@ -1364,6 +1451,7 @@ def main():
             "tp_overlap": tp_overlap,
             "pp_schedules": pp_schedules,
             "resilience": resilience,
+            "elastic": elastic,
             "observability": rounded(observability),
             "serving_observability": rounded(serving_obs),
             "lint": lint_gate,
