@@ -123,9 +123,6 @@ class InferenceEngine:
         cfg = model.cfg
         self.model = model
         self.params = params
-        self.cache = KVCache(max_slots, cfg.num_layers,
-                             max_seq or cfg.max_seq_len, cfg.local_heads,
-                             cfg.head_dim, cache_dtype or cfg.dtype)
         self.clock = clock
         # `registry` merges this engine's serving series into a shared
         # apex_tpu.observability.MetricsRegistry (one Prometheus/JSONL
@@ -146,6 +143,27 @@ class InferenceEngine:
         self._progress: dict = {}        # request_id -> tokens generated
                                          # before a preemption requeue
         self._done: List[Response] = []
+        self._init_backend(max_slots, max_seq or cfg.max_seq_len,
+                           cache_dtype or cfg.dtype)
+        # cache-accounting gauges (registry-deduplicated): the router
+        # and admission policies read capacity in bytes, not slots
+        self._g_kv_free = self.metrics.registry.gauge(
+            "serving_kv_free_bytes", "free KV-cache bytes")
+        self._g_kv_occ = self.metrics.registry.gauge(
+            "serving_kv_occupancy",
+            "fraction of KV-cache capacity in use (token-granular)")
+        self._export_cache_gauges()
+
+    def _init_backend(self, max_slots: int, max_seq: int,
+                      cache_dtype) -> None:
+        """Backend hook: build the KV store and the jitted device
+        programs.  The base engine is the contiguous slot ring;
+        :class:`apex_tpu.serving.PagedInferenceEngine` overrides this
+        with the block pool."""
+        cfg = self.model.cfg
+        self.cache = KVCache(max_slots, cfg.num_layers, max_seq,
+                             cfg.local_heads, cfg.head_dim, cache_dtype)
+        self.max_seq = self.cache.max_seq
         # the cache buffer threads through every step: donate it so XLA
         # updates it in place — without donation every decode step holds
         # TWO full caches (the lint rule donation/missing).  Donation
@@ -153,8 +171,12 @@ class InferenceEngine:
         # shape/dtype, which the cache ring guarantees; step() rebinds
         # self.cache.data from the output, so nothing re-reads the
         # donated buffer
-        self._decode = jax.jit(model.decode_step, donate_argnums=(2,))
-        self._prefill = jax.jit(model.prefill)
+        self._decode = jax.jit(self.model.decode_step, donate_argnums=(2,))
+        self._prefill = jax.jit(self.model.prefill)
+
+    def _export_cache_gauges(self) -> None:
+        self._g_kv_free.set(self.cache.free_bytes())
+        self._g_kv_occ.set(self.cache.occupancy())
 
     # -- request lifecycle ---------------------------------------------------
 
@@ -162,10 +184,10 @@ class InferenceEngine:
         """Reject statically-checkable poison at the door (what this
         can't see — e.g. a sampling config that only fails at decode
         time — the step-loop quarantine catches)."""
-        if not 0 < len(request.prompt) < self.cache.max_seq:
+        if not 0 < len(request.prompt) < self.max_seq:
             raise ValueError(
                 f"prompt length {len(request.prompt)} must be in "
-                f"(0, {self.cache.max_seq}) to leave room for decode")
+                f"(0, {self.max_seq}) to leave room for decode")
         vocab = self.model.cfg.vocab_size
         for t in request.prompt:
             if not isinstance(t, (int, np.integer)) or not 0 <= t < vocab:
@@ -205,11 +227,15 @@ class InferenceEngine:
     def queue_depth(self) -> int:
         return len(self._queue)
 
+    @property
+    def active_requests(self) -> int:
+        return len(self._active)
+
     def _bucket(self, n: int) -> int:
         b = self._min_bucket
         while b < n:
             b *= 2
-        return min(b, self.cache.max_seq)
+        return min(b, self.max_seq)
 
     def _sample(self, req: Request, logits_row, token_index: int) -> int:
         if req.sampling.greedy:
@@ -218,9 +244,14 @@ class InferenceEngine:
                                  token_index)
         return int(sample(jnp.asarray(logits_row), req.sampling, key))
 
+    def _release(self, slot: int, st: _Active) -> None:
+        """Backend hook: return ``slot``'s KV storage (a cache row here;
+        pool blocks + the draft row in the paged engine)."""
+        self.cache.free(slot)
+
     def _finish(self, slot: int, st: _Active, reason: str,
                 error: Optional[str] = None) -> None:
-        self.cache.free(slot)
+        self._release(slot, st)
         del self._active[slot]
         self._finish_response(st.request, st.generated, reason, error)
 
@@ -252,7 +283,7 @@ class InferenceEngine:
             self._finish(slot, st, "eos")
         elif len(st.generated) >= req.max_new_tokens:
             self._finish(slot, st, "length")
-        elif st.position >= self.cache.max_seq:
+        elif st.position >= self.max_seq:
             self._finish(slot, st, "length")      # cache row exhausted
         else:
             return False
@@ -306,19 +337,26 @@ class InferenceEngine:
         """
         requeued = 0
         for slot in sorted(self._active, reverse=True):
-            st = self._active[slot]
-            req = st.request
-            if len(req.prompt) + len(st.generated) >= self.cache.max_seq:
-                self._finish(slot, st, "preempted")
-                continue
-            self.cache.free(slot)
-            del self._active[slot]
-            self._progress[req.request_id] = list(st.generated)
-            self.metrics.request_requeued(req.request_id)
-            self.trace.requeue(req.request_id)
-            self._queue.appendleft(req)
-            requeued += 1
+            requeued += self._preempt_slot(slot)
         return requeued
+
+    def _preempt_slot(self, slot: int) -> int:
+        """Requeue one in-flight request (the per-slot body of
+        :meth:`preempt`; the paged engine also invokes it to reclaim
+        blocks under pool pressure).  Returns 1 when requeued, 0 when
+        the request had to finish instead."""
+        st = self._active[slot]
+        req = st.request
+        if len(req.prompt) + len(st.generated) >= self.max_seq:
+            self._finish(slot, st, "preempted")
+            return 0
+        self._release(slot, st)
+        del self._active[slot]
+        self._progress[req.request_id] = list(st.generated)
+        self.metrics.request_requeued(req.request_id)
+        self.trace.requeue(req.request_id)
+        self._queue.appendleft(req)
+        return 1
 
     def _admit(self) -> None:
         while self._queue and self.cache.free_slots:
@@ -362,6 +400,7 @@ class InferenceEngine:
         Returns True while there is (or may be) work left."""
         self._evict_expired()
         self._admit()
+        self._export_cache_gauges()
         if not self._active:
             return bool(self._queue)
         n = self.cache.slots
@@ -374,10 +413,21 @@ class InferenceEngine:
             self.params, jnp.asarray(tokens), self.cache.data,
             jnp.asarray(positions))
         self.metrics.step(len(self._active), n)
-        logits_np = np.asarray(logits)
-        for slot in sorted(self._active):
+        self._advance_slots(sorted(self._active), np.asarray(logits))
+        return bool(self._active or self._queue)
+
+    def _cache_advance(self, slot: int, st: _Active) -> None:
+        """Backend hook: record that the fed token's K/V is cached."""
+        self.cache.advance(slot)
+
+    def _advance_slots(self, slots: Sequence[int], logits_np) -> None:
+        """Post-decode tail shared by every backend: sample each row at
+        its stream index, append, and run the completion checks.  This
+        being single-sourced is what keeps the paged engine's sampling
+        stream bitwise-identical to the contiguous one."""
+        for slot in slots:
             st = self._active[slot]
-            self.cache.advance(slot)           # the fed token is cached now
+            self._cache_advance(slot, st)      # the fed token is cached now
             try:
                 tok = self._sample(st.request, logits_np[slot],
                                    len(st.generated))
@@ -391,7 +441,6 @@ class InferenceEngine:
             st.next_token = tok
             st.position += 1
             self._maybe_finish(slot, st)
-        return bool(self._active or self._queue)
 
     def run(self, max_steps: Optional[int] = None) -> List[Response]:
         """Drive :meth:`step` until every submitted request completes

@@ -54,6 +54,29 @@ class KVCache:
     def active_slots(self) -> int:
         return self.slots - len(self._free)
 
+    @property
+    def slot_bytes(self) -> int:
+        """HBM footprint of one slot row."""
+        return (int(np.prod(self.data.shape[1:]))
+                * self.data.dtype.itemsize)
+
+    def free_bytes(self) -> int:
+        """Bytes of cache capacity no request is holding (free slots)."""
+        return len(self._free) * self.slot_bytes
+
+    def used_bytes(self) -> int:
+        """Bytes actually covered by valid entries — token-granular, not
+        slot-granular: a slot holding a 10-token context counts 10
+        positions' worth, not ``max_seq``.  The difference between this
+        and ``slots*slot_bytes - free_bytes()`` is internal
+        fragmentation, which is exactly what the paged cache removes."""
+        return int(self.lengths.sum()) * self.slot_bytes // self.max_seq
+
+    def occupancy(self) -> float:
+        """Fraction of total cache capacity holding valid tokens
+        (token-granular; the admission/routing signal)."""
+        return float(self.lengths.sum()) / (self.slots * self.max_seq)
+
     def allocate(self) -> Optional[int]:
         """Claim a free slot id, or None when fully occupied."""
         if not self._free:

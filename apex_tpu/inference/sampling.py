@@ -1,4 +1,4 @@
-"""Token sampling for the decode loop: greedy, temperature, top-k.
+"""Token sampling for the decode loop: greedy, temperature, top-k, top-p.
 
 ``temperature == 0`` means greedy (argmax) — the deterministic mode the
 engine's batched-vs-isolated parity guarantee is stated for.  Stochastic
@@ -22,20 +22,47 @@ class SamplingParams:
 
     ``temperature``: 0.0 → greedy; otherwise logits are divided by it.
     ``top_k``: restrict sampling to the k highest-probability tokens
-    (None → full vocab).  Ignored under greedy.
+    (None → full vocab).  ``top_p``: nucleus sampling — keep the
+    smallest set of tokens whose cumulative probability reaches
+    ``top_p`` (None or 1.0 → full vocab); composes with ``top_k``
+    (k-filter first, then the nucleus over what survives, the usual
+    stacking order).  Both are ignored under greedy.
     """
     temperature: float = 0.0
     top_k: Optional[int] = None
+    top_p: Optional[float] = None
 
     def __post_init__(self):
         if self.temperature < 0.0:
             raise ValueError("temperature must be >= 0")
         if self.top_k is not None and self.top_k <= 0:
             raise ValueError("top_k must be positive")
+        if self.top_p is not None and not 0.0 < self.top_p <= 1.0:
+            raise ValueError("top_p must be in (0, 1]")
 
     @property
     def greedy(self) -> bool:
         return self.temperature == 0.0
+
+
+def _nucleus_filter(scaled, top_p: float):
+    """Mask ``scaled`` logits outside the smallest prefix of the
+    probability-sorted vocab whose cumulative mass reaches ``top_p``.
+
+    A token is kept iff the cumulative probability *before* it (in
+    descending order) is < ``top_p`` — so the token that crosses the
+    threshold is included and at least one token always survives.
+    Deterministic in the logits alone: ties at the cut keep every tied
+    token, never a data-dependent subset.
+    """
+    probs = jax.nn.softmax(scaled, axis=-1)
+    sorted_p = jnp.sort(probs, axis=-1)[..., ::-1]
+    cum_before = jnp.cumsum(sorted_p, axis=-1) - sorted_p
+    keep = cum_before < top_p
+    # smallest kept probability = the nucleus threshold
+    thr = jnp.min(jnp.where(keep, sorted_p, jnp.inf), axis=-1,
+                  keepdims=True)
+    return jnp.where(probs >= thr, scaled, -jnp.inf)
 
 
 def sample(logits, params: SamplingParams = SamplingParams(), key=None):
@@ -52,4 +79,6 @@ def sample(logits, params: SamplingParams = SamplingParams(), key=None):
     if params.top_k is not None and params.top_k < logits.shape[-1]:
         kth = jnp.sort(scaled, axis=-1)[..., -params.top_k][..., None]
         scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    if params.top_p is not None and params.top_p < 1.0:
+        scaled = _nucleus_filter(scaled, params.top_p)
     return jax.random.categorical(key, scaled, axis=-1)

@@ -26,7 +26,9 @@ import jax.numpy as jnp
 
 from apex_tpu.normalization import MixedFusedLayerNorm
 from apex_tpu.ops.flash_attention import (flash_attention,
-                                          flash_attention_decode)
+                                          flash_attention_chunk_paged,
+                                          flash_attention_decode,
+                                          flash_attention_decode_paged)
 from apex_tpu.ops.rope import (fused_apply_rotary_pos_emb_at_positions,
                                fused_apply_rotary_pos_emb_cached, rope_freqs)
 from apex_tpu.transformer import tensor_parallel as tp
@@ -282,6 +284,76 @@ class ParallelAttention:
                            ctx.reshape(b, 1, q.shape[1] * cfg.head_dim))
         return out, cache
 
+    def decode_paged(self, params, x, pool, layer_index, block_tables,
+                     positions):
+        """One-token decode against a paged block pool — op-for-op the
+        contiguous :meth:`decode` with the cache read/write indirected
+        through ``block_tables`` (``(b, max_blocks)``; ``pool``:
+        ``(num_blocks, layers, 2, block_size, kv_heads, head_dim)``).
+        RoPE tables are built at the pool's logical depth
+        ``max_blocks * block_size``, whose rows are bitwise independent
+        of the total length — paged and contiguous rows match exactly.
+        """
+        cfg = self.cfg
+        b = x.shape[0]
+        bs = pool.shape[3]
+        q, k, v = self._qkv(params, x)           # (b, 1, nh, hd)
+        q, k, v = q[:, 0], k[:, 0], v[:, 0]      # (b, nh, hd)
+        if cfg.rotary:
+            f = rope_freqs(block_tables.shape[1] * bs, cfg.head_dim)
+            q = fused_apply_rotary_pos_emb_at_positions(
+                q, jnp.cos(f), jnp.sin(f), positions)
+            k = fused_apply_rotary_pos_emb_at_positions(
+                k, jnp.cos(f), jnp.sin(f), positions)
+        rows = jnp.arange(b)
+        bids = block_tables[rows, positions // bs]
+        offs = positions % bs
+        pool = pool.at[bids, layer_index, 0, offs].set(
+            k.astype(pool.dtype))
+        pool = pool.at[bids, layer_index, 1, offs].set(
+            v.astype(pool.dtype))
+        ctx = flash_attention_decode_paged(
+            q, pool[:, layer_index, 0], pool[:, layer_index, 1],
+            block_tables, positions + 1)
+        out, _ = self.proj(params["proj"],
+                           ctx.reshape(b, 1, q.shape[1] * cfg.head_dim))
+        return out, pool
+
+    def decode_chunk(self, params, x, pool, layer_index, block_tables,
+                     positions, write_blocks, write_offsets):
+        """Multi-token decode against the pool (chunked prefill /
+        speculative verify): ``x`` is ``(b, chunk, hidden)``,
+        ``positions`` ``(b, chunk)`` absolute, and
+        ``write_blocks``/``write_offsets`` ``(b, chunk)`` are the
+        host-precomputed pool coordinates for each token's K/V (pad rows
+        point at garbage block 0).  Attends causally over the whole
+        cached context up to each query's position."""
+        cfg = self.cfg
+        b, c = x.shape[:2]
+        q, k, v = self._qkv(params, x)           # (b, c, nh, hd)
+        nh = q.shape[2]
+        if cfg.rotary:
+            f = rope_freqs(block_tables.shape[1] * pool.shape[3],
+                           cfg.head_dim)
+            cos, sin = jnp.cos(f), jnp.sin(f)
+            flat = positions.reshape(-1)
+            q = fused_apply_rotary_pos_emb_at_positions(
+                q.reshape(b * c, nh, cfg.head_dim), cos, sin, flat
+            ).reshape(b, c, nh, cfg.head_dim)
+            k = fused_apply_rotary_pos_emb_at_positions(
+                k.reshape(b * c, nh, cfg.head_dim), cos, sin, flat
+            ).reshape(b, c, nh, cfg.head_dim)
+        pool = pool.at[write_blocks, layer_index, 0, write_offsets].set(
+            k.astype(pool.dtype))
+        pool = pool.at[write_blocks, layer_index, 1, write_offsets].set(
+            v.astype(pool.dtype))
+        ctx = flash_attention_chunk_paged(
+            q.transpose(0, 2, 1, 3), pool[:, layer_index, 0],
+            pool[:, layer_index, 1], block_tables, positions)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(b, c, nh * cfg.head_dim)
+        out, _ = self.proj(params["proj"], ctx)
+        return out, pool
+
 
 class ParallelMLP:
     """Column→GELU→Row block (apex ParallelMLP)."""
@@ -420,6 +492,38 @@ class ParallelTransformerLayer:
         if self.is_moe:
             y, _ = y
         return x + y, cache
+
+    def decode_paged(self, params, x, pool, layer_index, block_tables,
+                     positions):
+        """Paged-pool analog of :meth:`decode` (same residual/LN/MLP
+        tail — only the attention cache access is indirected)."""
+        h = self.input_layernorm(params["input_layernorm"], x)
+        attn, pool = self.attention.decode_paged(
+            params["attention"], h, pool, layer_index, block_tables,
+            positions)
+        x = x + attn
+        h = self.post_attention_layernorm(
+            params["post_attention_layernorm"], x)
+        y = self.mlp(params["mlp"], h)
+        if self.is_moe:
+            y, _ = y
+        return x + y, pool
+
+    def decode_chunk(self, params, x, pool, layer_index, block_tables,
+                     positions, write_blocks, write_offsets):
+        """Chunked decode through this layer; see
+        :meth:`ParallelAttention.decode_chunk`."""
+        h = self.input_layernorm(params["input_layernorm"], x)
+        attn, pool = self.attention.decode_chunk(
+            params["attention"], h, pool, layer_index, block_tables,
+            positions, write_blocks, write_offsets)
+        x = x + attn
+        h = self.post_attention_layernorm(
+            params["post_attention_layernorm"], x)
+        y = self.mlp(params["mlp"], h)
+        if self.is_moe:
+            y, _ = y
+        return x + y, pool
 
 
 class GPTModel:
@@ -662,6 +766,62 @@ class GPTModel:
         logits = jnp.einsum("bh,vh->bv", x[:, 0].astype(_f32),
                             w.astype(_f32))
         return logits, cache
+
+    def decode_step_paged(self, params, tokens, pool, block_tables,
+                          positions):
+        """One batched decode step against a paged block pool.
+
+        Mirrors :meth:`decode_step` op-for-op — same embed, same RoPE
+        rows, same f32 head einsum — with the cache access indirected
+        through ``block_tables`` (``(slots, max_blocks)`` int32; see
+        :class:`apex_tpu.serving.PagedKVCache`).  Off-TPU the attention
+        gathers the table back to the contiguous layout and runs the
+        identical reference, which is why the serving engine's
+        paged-vs-contiguous parity is bitwise, not approximate.  Rows
+        whose table is all-garbage (block 0) compute garbage that is
+        never read, like inactive slots in :meth:`decode_step`.
+        """
+        self._check_decode_supported()
+        x = self.embedding(params["embedding"], tokens[:, None])
+        if not self.cfg.rotary:
+            x = x + params["position_embedding"][positions][:, None]
+        x = x.astype(self.cfg.dtype)
+        for li, (layer, lp) in enumerate(zip(self.layers,
+                                             params["layers"])):
+            x, pool = layer.decode_paged(lp, x, pool, li, block_tables,
+                                         positions)
+        x = self.final_layernorm(params["final_layernorm"], x)
+        w = params["embedding"]["weight"]
+        logits = jnp.einsum("bh,vh->bv", x[:, 0].astype(_f32),
+                            w.astype(_f32))
+        return logits, pool
+
+    def decode_chunk(self, params, tokens, pool, block_tables, positions,
+                     write_blocks, write_offsets):
+        """Process ``chunk`` tokens per sequence against the paged pool
+        in one forward — the workhorse of chunked prefill (a prompt slice
+        at a time, mixed into decode ticks) and speculative verification
+        (score γ draft tokens in one pass).
+
+        ``tokens``/``positions``/``write_blocks``/``write_offsets``:
+        ``(slots, chunk)`` — each token's id, absolute position, and
+        host-precomputed pool write coordinates (pad rows target garbage
+        block 0).  Returns ``(logits, pool)`` with ``logits``
+        ``(slots, chunk, vocab)`` through the same tied head as
+        :meth:`prefill`'s — the chunk's final row is what admission
+        samples the first token from.
+        """
+        self._check_decode_supported()
+        x = self.embedding(params["embedding"], tokens)
+        if not self.cfg.rotary:
+            x = x + params["position_embedding"][positions]
+        x = x.astype(self.cfg.dtype)
+        for li, (layer, lp) in enumerate(zip(self.layers,
+                                             params["layers"])):
+            x, pool = layer.decode_chunk(lp, x, pool, li, block_tables,
+                                         positions, write_blocks,
+                                         write_offsets)
+        return self.logits(params, x), pool
 
     def loss(self, params, tokens, targets, dropout_seed=None):
         """Mean next-token loss via vocab-parallel cross entropy (+ the

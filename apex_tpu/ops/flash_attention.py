@@ -47,7 +47,9 @@ _f32 = jnp.float32
 _MASK = -1e30  # finite "minus infinity": exp(_MASK - m) == 0, no NaNs
 
 __all__ = ["flash_attention", "flash_attention_reference",
-           "flash_attention_decode", "flash_attention_decode_reference"]
+           "flash_attention_decode", "flash_attention_decode_reference",
+           "flash_attention_decode_paged", "flash_attention_chunk_paged",
+           "gather_paged_kv"]
 
 
 # ---------------------------------------------------------------------------
@@ -705,6 +707,164 @@ def flash_attention_decode(q, k_cache, v_cache, cache_lens,
         interpret=interpret_mode(),
     )(cache_lens, qp, kp, vp)
     return out[:, :, :d]
+
+
+def gather_paged_kv(pool, block_tables):
+    """Materialize a paged cache as the contiguous layout.
+
+    ``pool``: ``(num_blocks, block_size, heads, head_dim)`` (one layer,
+    one of K/V); ``block_tables``: ``(batch, max_blocks)`` int.  Returns
+    ``(batch, max_blocks * block_size, heads, head_dim)`` — positions
+    map as ``p -> (table[p // bs], p % bs)``, so the gathered array is
+    elementwise IDENTICAL to a contiguous cache at every valid position
+    (garbage-block rows land at masked positions).  This is the off-TPU
+    paged path and the parity bridge to the contiguous kernels.
+    """
+    b, nb = block_tables.shape
+    bs, h, d = pool.shape[1:]
+    return pool[block_tables].reshape(b, nb * bs, h, d)
+
+
+def _decode_paged_kernel(scale, bs, len_ref, tbl_ref, q_ref, k_ref, v_ref,
+                         o_ref, m_scr, l_scr, acc_scr):
+    """Single-query decode over a BLOCK TABLE: identical online-softmax
+    math to :func:`_decode_kernel`, but the kv BlockSpec's index_map
+    reads the physical block id from the scalar-prefetched table, so the
+    DMA engine walks ``tbl[b, ki]`` instead of a contiguous row."""
+    b = pl.program_id(0)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr[:], _MASK)
+        l_scr[:] = jnp.zeros_like(l_scr[:])
+        acc_scr[:] = jnp.zeros_like(acc_scr[:])
+
+    @pl.when(ki * bs < len_ref[b])
+    def _compute():
+        q = q_ref[0]                              # (1, d_pad)
+        k = k_ref[0, :, 0, :]                     # (bs, d_pad)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=_f32) * scale
+        k_pos = ki * bs + jax.lax.broadcasted_iota(
+            jnp.int32, (1, bs), 1)
+        valid = k_pos < len_ref[b]
+        s = jnp.where(valid, s, _MASK)
+        m_prev = m_scr[:, :1]
+        m_cur = jnp.maximum(jnp.max(s, axis=1, keepdims=True), m_prev)
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.where(valid, jnp.exp(s - m_cur), 0.0)
+        l_cur = alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0, :, 0, :],
+            (((1,), (0,)), ((), ())), preferred_element_type=_f32)
+        acc_scr[:] = acc_scr[:] * alpha + pv
+        m_scr[:] = jnp.broadcast_to(m_cur, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_cur, l_scr.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+
+
+def flash_attention_decode_paged(q, k_pool, v_pool, block_tables,
+                                 cache_lens, softmax_scale=None):
+    """Single-token decode attention over a paged KV pool.
+
+    ``q``: ``(batch, heads, head_dim)``; ``k_pool``/``v_pool``:
+    ``(num_blocks, block_size, heads, head_dim)`` — ONE layer's K (or V)
+    blocks from :class:`apex_tpu.serving.PagedKVCache`;
+    ``block_tables``: ``(batch, max_blocks)`` int32 physical block ids
+    per logical block (garbage-padded rows use block 0);
+    ``cache_lens``: ``(batch,)`` valid lengths.
+
+    Semantics are exactly :func:`flash_attention_decode` on the gathered
+    contiguous cache — and the off-TPU path literally IS that: gather +
+    the same masked reference, which is what makes paged decode
+    token-bitwise-identical to the contiguous engine on CPU.  On TPU a
+    Pallas kernel walks the block table via scalar prefetch
+    (``PrefetchScalarGridSpec``) so the gather never materializes.
+    """
+    b, h, d = q.shape
+    bs = k_pool.shape[1]
+    nb = block_tables.shape[1]
+    scale = float(softmax_scale if softmax_scale is not None
+                  else d ** -0.5)
+    cache_lens = cache_lens.astype(jnp.int32)
+    block_tables = block_tables.astype(jnp.int32)
+    if not use_pallas():
+        return flash_attention_decode_reference(
+            q, gather_paged_kv(k_pool, block_tables),
+            gather_paged_kv(v_pool, block_tables), cache_lens, scale)
+    d_pad = _round_up(d, 128)
+    qp = q if d == d_pad else jnp.pad(q, ((0, 0), (0, 0), (0, d_pad - d)))
+
+    def _pad_pool(c):
+        if d == d_pad:
+            return c
+        return jnp.pad(c, ((0, 0), (0, 0), (0, 0), (0, d_pad - d)))
+
+    kernel = functools.partial(_decode_paged_kernel, scale, bs)
+    qo_spec = pl.BlockSpec((1, 1, d_pad),
+                           lambda bi, hi, ki, lens, tbl: (bi, hi, 0),
+                           memory_space=pltpu.VMEM)
+    kv_spec = pl.BlockSpec(
+        (1, bs, 1, d_pad),
+        lambda bi, hi, ki, lens, tbl: (tbl[bi, ki], 0, hi, 0),
+        memory_space=pltpu.VMEM)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, h, nb),
+        in_specs=[qo_spec, kv_spec, kv_spec],
+        out_specs=qo_spec,
+        scratch_shapes=[pltpu.VMEM((1, 128), _f32),
+                        pltpu.VMEM((1, 128), _f32),
+                        pltpu.VMEM((1, d_pad), _f32)])
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=_sds((b, h, d_pad), q.dtype, q),
+        compiler_params=_compiler_params(),
+        interpret=interpret_mode(),
+    )(cache_lens, block_tables, qp, _pad_pool(k_pool), _pad_pool(v_pool))
+    return out[:, :, :d]
+
+
+def flash_attention_chunk_paged(q, k_pool, v_pool, block_tables,
+                                q_positions, softmax_scale=None):
+    """Multi-query decode attention over a paged pool (chunked prefill
+    and speculative verification).
+
+    ``q``: ``(batch, heads, chunk, head_dim)`` — ``chunk`` query tokens
+    per sequence, NOT necessarily starting at position 0;
+    ``q_positions``: ``(batch, chunk)`` each query's absolute position.
+    Key position ``kp`` is visible to query ``j`` iff
+    ``kp <= q_positions[:, j]`` — causality over the whole cached
+    context, matching prefill exactly for in-order chunks.  Pools and
+    tables as in :func:`flash_attention_decode_paged`; the chunk's own
+    K/V must be written to the pool before the call.
+
+    Runs as a masked jnp gather on every backend (chunks are short and
+    wide enough that XLA fuses this well; the single-token fast path is
+    the Pallas kernel above).  f32 scores/accumulation as everywhere.
+    """
+    b, h, c, d = q.shape
+    scale = softmax_scale if softmax_scale is not None else d ** -0.5
+    k = gather_paged_kv(k_pool, block_tables)     # (b, S, h, d)
+    v = gather_paged_kv(v_pool, block_tables)
+    S = k.shape[1]
+    s = jnp.einsum("bhcd,bshd->bhcs", q.astype(_f32),
+                   k.astype(_f32)) * scale
+    valid = (jnp.arange(S)[None, None, None, :]
+             <= q_positions[:, None, :, None])    # (b, 1, c, S)
+    s = jnp.where(valid, s, _MASK)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(valid, p, 0.0)
+    o = jnp.einsum("bhcs,bshd->bhcd", p, v.astype(_f32))
+    return o.astype(q.dtype)
 
 
 def flash_attention(q, k, v, causal=False, softmax_scale=None,

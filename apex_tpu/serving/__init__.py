@@ -1,0 +1,34 @@
+"""apex_tpu.serving — the production-serving tier on top of inference.
+
+What :mod:`apex_tpu.inference` leaves on the table, this package takes:
+
+* :class:`PagedKVCache` — vLLM-style block-pool KV storage with
+  ref-counted, radix-trie-keyed prefix sharing (a fleet's shared system
+  prompt is cached ONCE) and copy-on-write forking.
+* :class:`PagedInferenceEngine` — the continuous-batching engine over
+  the block pool, token-bitwise-identical to the contiguous engine,
+  with chunked prefill (:class:`TickScheduler` budgets) and
+  exact-match speculative decoding (:class:`SpeculativeConfig`).
+* :class:`Router` — SLO-burn-aware multi-replica admission with
+  explicit shedding (:class:`RequestShed`).
+
+``tools/loadgen.py`` drives the stack under heavy-tail open-loop
+traffic and reports TTFT/TPOT/e2e percentiles.
+"""
+
+from apex_tpu.serving.engine import PagedInferenceEngine
+from apex_tpu.serving.paged_kv import PagedKVCache, PagedSequence
+from apex_tpu.serving.router import RequestShed, Router
+from apex_tpu.serving.scheduler import TickPlan, TickScheduler
+from apex_tpu.serving.speculative import SpeculativeConfig
+
+__all__ = [
+    "PagedInferenceEngine",
+    "PagedKVCache",
+    "PagedSequence",
+    "RequestShed",
+    "Router",
+    "TickPlan",
+    "TickScheduler",
+    "SpeculativeConfig",
+]

@@ -1,0 +1,449 @@
+"""Paged continuous-batching engine: the serving tier of apex_tpu.
+
+:class:`PagedInferenceEngine` subclasses the contiguous
+:class:`~apex_tpu.inference.InferenceEngine` and swaps ONLY the memory
+backend and the per-tick device plan; the whole request lifecycle —
+validation, bounded-queue backpressure, eviction/timeout, quarantine,
+preemption-requeue, metrics/trace — is inherited, and so is the
+sampling stream (``_sample`` keyed by ``(seed, token-index)``).  That
+shared lifecycle plus the gather-identical paged attention path is why
+the engine's outputs are token-BITWISE-identical to the contiguous
+engine for greedy and seeded sampling (asserted by
+``__graft_entry__._dryrun_serving`` and ``tests/test_serving.py``),
+while memory goes from ``slots * max_seq`` rows to demand-allocated
+blocks with prefix sharing.
+
+Three independently-switchable serving features:
+
+* **Paged KV + prefix sharing** (always on): admission acquires blocks
+  from :class:`~apex_tpu.serving.PagedKVCache`; a prompt sharing a
+  cached full-block prefix skips both the KV writes AND (under chunked
+  prefill) the forward compute for the shared tokens.  When the pool
+  runs dry mid-decode the engine preempts the most recently admitted
+  request (release blocks → requeue-with-progress → recompute later),
+  the vLLM recovery policy, reusing the resilience machinery of
+  ``preempt()``.
+* **Chunked prefill** (``chunked_prefill=True``): prompts are processed
+  in scheduler-budgeted chunks mixed into decode ticks instead of one
+  monolithic prefill at admission — no head-of-line blocking of decode
+  behind a long prompt.  Chunked token parity vs the contiguous path is
+  deterministic and asserted at token level (the chunk forward is a
+  different — gather-based — compute schedule from the bucketed
+  prefill, so per-logit bitwiseness is not guaranteed by construction
+  the way pure paged decode is).
+* **Speculative decoding** (``speculative=SpeculativeConfig(...)``):
+  see :mod:`apex_tpu.serving.speculative` — the draft proposes γ
+  tokens, one (γ+1)-wide target chunk verifies, exact-match acceptance
+  preserves the sampling stream exactly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.inference.engine import InferenceEngine, _Active
+from apex_tpu.inference.kv_cache import KVCache
+from apex_tpu.serving.paged_kv import PagedKVCache
+from apex_tpu.serving.scheduler import TickScheduler
+from apex_tpu.serving.speculative import SpeculativeConfig
+
+
+@dataclasses.dataclass
+class _ChunkPrefill:
+    """Progress of one chunked prefill: ``ctx`` is the full context
+    (prompt + requeued progress), ``done`` how many positions already
+    hold KV (starts at the trie-shared prefix — shared tokens are never
+    re-forwarded, the compute half of the prefix-cache win)."""
+    ctx: List[int]
+    done: int
+    prev_len: int       # generated-so-far count (resume stream index)
+
+
+class PagedInferenceEngine(InferenceEngine):
+    """Continuous batching over a paged block pool."""
+
+    def __init__(self, model, params, *, block_size: int = 8,
+                 num_blocks: Optional[int] = None,
+                 share_prefixes: bool = True,
+                 chunked_prefill: bool = False,
+                 scheduler: Optional[TickScheduler] = None,
+                 speculative: Optional[SpeculativeConfig] = None,
+                 **kw):
+        self._block_size = block_size
+        self._num_blocks = num_blocks
+        self._share_prefixes = share_prefixes
+        self.chunked_prefill = chunked_prefill
+        self.scheduler = scheduler or TickScheduler()
+        self.spec = speculative
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        super().__init__(model, params, **kw)
+
+    # -- backend -------------------------------------------------------------
+
+    def _init_backend(self, max_slots: int, max_seq: int,
+                      cache_dtype) -> None:
+        cfg = self.model.cfg
+        bs = self._block_size
+        if max_seq % bs:
+            raise ValueError(
+                f"max_seq ({max_seq}) must be a multiple of block_size "
+                f"({bs}) — equal logical depth is what keeps paged "
+                "attention bitwise-identical to the contiguous cache")
+        self.max_seq = max_seq
+        self.max_slots = max_slots
+        self.max_blocks = max_seq // bs
+        if self._num_blocks is None:
+            # as roomy as the contiguous ring it replaces (+ garbage
+            # block); real deployments size this to HBM, not to slots
+            self._num_blocks = 1 + max_slots * self.max_blocks
+        self.pool = PagedKVCache(
+            self._num_blocks, bs, cfg.num_layers, cfg.local_heads,
+            cfg.head_dim, cache_dtype, share_prefixes=self._share_prefixes,
+            registry=self.metrics.registry)
+        self._free_slots = list(range(max_slots - 1, -1, -1))
+        self._seqs: dict = {}            # slot -> PagedSequence
+        self._tables = np.zeros((max_slots, self.max_blocks), np.int32)
+        self._prefilling: dict = {}      # slot -> _ChunkPrefill
+        self._prefill_order: List[int] = []
+        self._admit_stamp: dict = {}     # slot -> admission counter
+        self._admitted = 0
+        self._decode_paged = jax.jit(self.model.decode_step_paged,
+                                     donate_argnums=(2,))
+        self._chunk = jax.jit(self.model.decode_chunk, donate_argnums=(2,))
+        self._prefill = jax.jit(self.model.prefill)
+        if self.spec is not None:
+            self.spec.validate_against(self.model)
+            dcfg = self.spec.model.cfg
+            # the draft keeps a plain contiguous ring aligned on the
+            # same slot ids (it is small — paging it buys nothing)
+            self._draft_cache = KVCache(max_slots, dcfg.num_layers,
+                                        max_seq, dcfg.local_heads,
+                                        dcfg.head_dim, cache_dtype)
+            self._draft_decode = jax.jit(self.spec.model.decode_step,
+                                         donate_argnums=(2,))
+            self._draft_prefill = jax.jit(self.spec.model.prefill)
+            r = self.metrics.registry
+            self._c_spec_prop = r.counter(
+                "serving_spec_proposed_total", "draft tokens proposed")
+            self._c_spec_acc = r.counter(
+                "serving_spec_accepted_total",
+                "draft tokens matching the canonical stream")
+
+    def _export_cache_gauges(self) -> None:
+        self._g_kv_free.set(self.pool.free_bytes())
+        self._g_kv_occ.set(self.pool.occupancy())
+
+    def _release(self, slot: int, st) -> None:
+        seq = self._seqs.pop(slot, None)
+        if seq is not None:
+            self.pool.release(seq)
+        self._tables[slot] = 0
+        self._prefilling.pop(slot, None)
+        if slot in self._prefill_order:
+            self._prefill_order.remove(slot)
+        self._admit_stamp.pop(slot, None)
+        self._free_slots.append(slot)
+
+    def _cache_advance(self, slot: int, st: _Active) -> None:
+        # st.position was already advanced past the cached token by the
+        # shared tail? No: _advance_slots calls this BEFORE appending,
+        # exactly like the contiguous engine — the token fed this step
+        # sits at st.position, so the valid length becomes position + 1.
+        self._seqs[slot].num_tokens = st.position + 1
+
+    # -- admission -----------------------------------------------------------
+
+    def _admit(self) -> None:
+        while self._queue and self._free_slots:
+            req = self._queue[0]
+            prev = self._progress.get(req.request_id)
+            ctx = list(req.prompt) + (prev or [])
+            seq = self.pool.acquire(ctx)
+            if seq is None:
+                # pool exhausted even after trie eviction: requests wait
+                # queued until decode completions free blocks
+                break
+            self._queue.popleft()
+            self._progress.pop(req.request_id, None)
+            slot = self._free_slots.pop()
+            self._admitted += 1
+            self._admit_stamp[slot] = self._admitted
+            if prev is None:
+                self.trace.admit(req.request_id)
+            clen = len(ctx)
+            self._seqs[slot] = seq
+            self._tables[slot] = self.pool.table_row(seq, self.max_blocks)
+            if self.chunked_prefill:
+                # defer ALL device work to budgeted chunks; the slot is
+                # active (evictable, preemptable) but not yet decoding
+                st = _Active(req, len(req.prompt), next_token=-1,
+                             position=clen, generated=list(prev or []))
+                self._active[slot] = st
+                self._prefilling[slot] = _ChunkPrefill(
+                    ctx, seq.shared_tokens, len(prev or []))
+                self._prefill_order.append(slot)
+                continue
+            try:
+                # monolithic prefill — same bucketing, same program, same
+                # logits as the contiguous engine (the bitwise mode)
+                toks = np.zeros((1, self._bucket(clen)), np.int32)
+                toks[0, :clen] = ctx
+                logits, kv = self._prefill(self.params, jnp.asarray(toks))
+                self.pool.write_context_kv(seq, kv[:, :, 0], clen)
+                self.pool.register_prefix(seq, ctx)
+                self._draft_admit(slot, ctx)
+                nxt = self._sample(req, np.asarray(logits[0, clen - 1]),
+                                   len(prev or []))
+            except Exception as e:          # quarantine, as in the base
+                self._release(slot, None)
+                self._finish_response(req, list(prev or []), "error",
+                                      error=f"{type(e).__name__}: {e}")
+                continue
+            if prev is None:
+                self.metrics.first_token(req.request_id)
+                self.trace.first_token(req.request_id)
+            else:
+                self.metrics.token(req.request_id)
+                self.trace.decode_tick(req.request_id)
+            st = _Active(req, len(req.prompt), next_token=nxt,
+                         position=clen, generated=(prev or []) + [nxt])
+            self._active[slot] = st
+            self._maybe_finish(slot, st)
+
+    def _draft_admit(self, slot: int, ctx: List[int]) -> None:
+        if self.spec is None:
+            return
+        toks = np.zeros((1, self._bucket(len(ctx))), np.int32)
+        toks[0, :len(ctx)] = ctx
+        _, kv = self._draft_prefill(self.spec.params, jnp.asarray(toks))
+        self._draft_cache.write_prompt(slot, kv[:, :, 0], len(ctx))
+
+    # -- pool pressure -------------------------------------------------------
+
+    def _grow(self, slot: int, n_tokens: int) -> bool:
+        """Extend ``slot``'s block table to ``n_tokens`` positions,
+        preempting the most recently admitted OTHER request when the
+        pool (and the prefix trie's evictable tail) cannot supply
+        blocks — recompute-on-readmission, the vLLM policy, riding the
+        engine's existing requeue machinery."""
+        seq = self._seqs[slot]
+        while not self.pool.ensure_capacity(seq, n_tokens):
+            victims = [s for s in self._admit_stamp if s != slot
+                       and s in self._active]
+            if not victims:
+                return False
+            self._preempt_slot(max(victims, key=self._admit_stamp.get))
+        self._tables[slot] = self.pool.table_row(seq, self.max_blocks)
+        return True
+
+    # -- the tick loop -------------------------------------------------------
+
+    def step(self) -> bool:
+        self._evict_expired()
+        self._admit()
+        self._export_cache_gauges()
+        if not self._active:
+            return bool(self._queue)
+        decoding = [s for s in self._active if s not in self._prefilling]
+        if self._prefilling:
+            plan = self.scheduler.plan(
+                len(decoding),
+                [(s, len(self._prefilling[s].ctx) - self._prefilling[s].done)
+                 for s in self._prefill_order],
+                self.spec.num_tokens if (self.spec and decoding) else 0)
+            for slot, n in plan.chunks.items():
+                if slot in self._prefilling:     # may have been evicted
+                    self._run_prefill_chunk(slot, n)
+        decoding = sorted(s for s in self._active
+                          if s not in self._prefilling)
+        if decoding:
+            if self.spec is not None:
+                self._spec_round(decoding)
+            else:
+                self._decode_round(decoding)
+        return bool(self._active or self._queue)
+
+    def _decode_round(self, decoding: List[int]) -> None:
+        for slot in list(decoding):
+            if slot in self._active and not self._grow(
+                    slot, self._active[slot].position + 1):
+                self._preempt_slot(slot)     # cannot even hold one more
+        decoding = [s for s in decoding if s in self._active]
+        if not decoding:
+            return
+        n = self.max_slots
+        tokens = np.zeros((n,), np.int32)
+        positions = np.zeros((n,), np.int32)
+        for slot in decoding:
+            st = self._active[slot]
+            tokens[slot] = st.next_token
+            positions[slot] = st.position
+        logits, self.pool.data = self._decode_paged(
+            self.params, jnp.asarray(tokens), self.pool.data,
+            jnp.asarray(self._tables), jnp.asarray(positions))
+        self.metrics.step(len(decoding), n)
+        self._advance_slots(decoding, np.asarray(logits))
+
+    # -- chunked prefill -----------------------------------------------------
+
+    def _run_prefill_chunk(self, slot: int, n: int) -> None:
+        cs = self._prefilling[slot]
+        st = self._active[slot]
+        seq = self._seqs[slot]
+        bs = self.pool.block_size
+        start = cs.done
+        end = min(start + n, len(cs.ctx))
+        c = end - start
+        pad = self._bucket(c)
+        toks = np.zeros((1, pad), np.int32)
+        pos = np.zeros((1, pad), np.int32)
+        wb = np.zeros((1, pad), np.int32)    # pad rows -> garbage block 0
+        wo = np.zeros((1, pad), np.int32)
+        toks[0, :c] = cs.ctx[start:end]
+        for j in range(c):
+            p = start + j
+            pos[0, j] = p
+            wb[0, j] = seq.block_ids[p // bs]
+            wo[0, j] = p % bs
+        try:
+            logits, self.pool.data = self._chunk(
+                self.params, jnp.asarray(toks), self.pool.data,
+                jnp.asarray(self._tables[slot:slot + 1]),
+                jnp.asarray(pos), jnp.asarray(wb), jnp.asarray(wo))
+            cs.done = end
+            if end < len(cs.ctx):
+                return
+            # prefill complete: publish, admit the draft, first token
+            self.pool.register_prefix(seq, cs.ctx)
+            self._draft_admit(slot, cs.ctx)
+            nxt = self._sample(st.request, np.asarray(logits)[0, c - 1],
+                               cs.prev_len)
+        except Exception as e:              # quarantine
+            self._finish(slot, st, "error",
+                         error=f"{type(e).__name__}: {e}")
+            return
+        if cs.prev_len == 0:
+            self.metrics.first_token(st.request.request_id)
+            self.trace.first_token(st.request.request_id)
+        else:
+            self.metrics.token(st.request.request_id)
+            self.trace.decode_tick(st.request.request_id)
+        st.next_token = nxt
+        st.generated.append(nxt)
+        del self._prefilling[slot]
+        self._prefill_order.remove(slot)
+        self._maybe_finish(slot, st)
+
+    # -- speculative decoding ------------------------------------------------
+
+    def _spec_round(self, decoding: List[int]) -> None:
+        k = self.spec.num_tokens
+        for slot in list(decoding):
+            if slot in self._active and not self._grow(
+                    slot,
+                    min(self._active[slot].position + k + 1, self.max_seq)):
+                self._preempt_slot(slot)
+        decoding = [s for s in decoding if s in self._active]
+        if not decoding:
+            return
+        n = self.max_slots
+        # 1) draft proposes k tokens (k cheap batched steps), sampling
+        #    with the SAME (seed, index) stream the target will replay
+        dtok = np.zeros((n,), np.int32)
+        dpos = np.zeros((n,), np.int32)
+        for s in decoding:
+            st = self._active[s]
+            dtok[s] = st.next_token
+            dpos[s] = st.position
+        proposals = np.zeros((n, k), np.int32)
+        data = self._draft_cache.data
+        cur = dtok
+        for j in range(k):
+            dlogits, data = self._draft_decode(
+                self.spec.params, jnp.asarray(cur), data,
+                jnp.asarray(dpos + j))
+            dl = np.asarray(dlogits)
+            for s in decoding:
+                st = self._active[s]
+                try:
+                    proposals[s, j] = self._sample(
+                        st.request, dl[s], len(st.generated) + j)
+                except Exception:
+                    # a poison sampling config detonates identically in
+                    # the verify loop, where quarantine handles it
+                    proposals[s, j] = 0
+            cur = proposals[:, j]
+        # one write-only step: on a full accept (all k proposals + the
+        # bonus token) the next round starts at p+k+1, so the draft
+        # needs d_k's KV at p+k — without this its later attention reads
+        # a stale row there (correctness is unaffected either way; the
+        # target verifies everything, this only protects accept rate)
+        _, data = self._draft_decode(
+            self.spec.params, jnp.asarray(cur), data,
+            jnp.asarray(dpos + k))
+        self._draft_cache.data = data
+        # 2) one (k+1)-wide target chunk verifies [t, d1..dk]
+        c = k + 1
+        toks = np.zeros((n, c), np.int32)
+        pos = np.zeros((n, c), np.int32)
+        wb = np.zeros((n, c), np.int32)
+        wo = np.zeros((n, c), np.int32)
+        bs = self.pool.block_size
+        lim = {}
+        for s in decoding:
+            st = self._active[s]
+            seq = self._seqs[s]
+            toks[s] = [st.next_token] + list(proposals[s])
+            lim[s] = min(c, self.max_seq - st.position)
+            for j in range(lim[s]):
+                p = st.position + j
+                pos[s, j] = p
+                wb[s, j] = seq.block_ids[p // bs]
+                wo[s, j] = p % bs
+        vlogits, self.pool.data = self._chunk(
+            self.params, jnp.asarray(toks), self.pool.data,
+            jnp.asarray(self._tables), jnp.asarray(pos),
+            jnp.asarray(wb), jnp.asarray(wo))
+        self.metrics.step(len(decoding), n)
+        vl = np.asarray(vlogits)
+        # 3) exact-match acceptance: consume canonical tokens while the
+        #    draft predicted them; first mismatch (or the bonus final
+        #    sample) ends the round
+        for s in decoding:
+            st = self._active[s]
+            seq = self._seqs[s]
+            for j in range(lim[s]):
+                try:
+                    tok = self._sample(st.request, vl[s, j],
+                                       len(st.generated))
+                except Exception as e:
+                    self._finish(s, st, "error",
+                                 error=f"{type(e).__name__}: {e}")
+                    break
+                self.metrics.token(st.request.request_id)
+                self.trace.decode_tick(st.request.request_id)
+                st.generated.append(tok)
+                st.next_token = tok
+                st.position += 1
+                seq.num_tokens = st.position
+                if self._maybe_finish(s, st):
+                    break
+                if j == lim[s] - 1:
+                    break
+                self.spec_proposed += 1
+                self._c_spec_prop.inc()
+                if tok != proposals[s, j]:
+                    break               # rejected KV stays masked garbage
+                self.spec_accepted += 1
+                self._c_spec_acc.inc()
+
+    @property
+    def spec_accept_rate(self) -> float:
+        return (self.spec_accepted / self.spec_proposed
+                if self.spec_proposed else 0.0)

@@ -1,0 +1,387 @@
+"""Paged (block) KV cache with ref-counted copy-on-write prefix sharing.
+
+vLLM-style memory management for the serving engine: instead of one
+contiguous ``max_seq`` row per request (``inference.KVCache``), KV lives
+in a pool of fixed-size blocks
+
+    ``(num_blocks, layers, 2, block_size, kv_heads, head_dim)``
+
+and each request owns an ordered *block table* mapping logical position
+``p`` to ``(table[p // block_size], p % block_size)``.  Admission
+allocates ``ceil(len / block_size)`` blocks instead of a whole row, so
+memory fragments by at most one block per request and short requests no
+longer pin ``max_seq`` worth of HBM.
+
+Prefix sharing: full blocks of prompt tokens are keyed in a radix trie
+(node key = the block's token tuple).  A new request whose prompt starts
+with an already-cached block chain *shares* those blocks (refcount + 1)
+instead of recomputing and rewriting them — a fleet of requests carrying
+the same system prompt stores its KV exactly once.  Sharing is safe
+bitwise because post-RoPE K/V for a token depends only on the token ids
+at and before it (verified by the engine parity tests across prompt
+buckets).  The trie itself holds one reference per cached block, so
+blocks outlive the request that produced them and are reclaimed lazily:
+when the free list runs dry, least-recently-matched leaves are evicted.
+
+Copy-on-write: writes must only ever target blocks with refcount 1.  The
+serve loop guarantees this structurally (shared blocks are always *full*
+prefix blocks; appends go to the exclusive tail), and :meth:`fork` +
+:meth:`ensure_writable` expose the general mechanism for parallel
+sampling — a forked sequence shares everything until its first divergent
+write, which copies just the written block.
+
+Block 0 is reserved as the *garbage block*: inactive decode-batch rows
+point their entire table at it, so their (mathematically garbage) writes
+can never corrupt a live block.
+
+Bookkeeping is host-side (python ints and lists, like ``KVCache``); the
+pool array is functional and reassigned on every device write.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class _TrieNode:
+    """One cached full block: ``key`` is the block's token tuple, keyed
+    under the parent (so the path from the root spells the prefix)."""
+
+    __slots__ = ("key", "block", "parent", "children", "stamp")
+
+    def __init__(self, key: Tuple[int, ...], block: int,
+                 parent: "_TrieNode"):
+        self.key = key
+        self.block = block
+        self.parent = parent
+        self.children: Dict[Tuple[int, ...], _TrieNode] = {}
+        self.stamp = 0
+
+
+class PagedSequence:
+    """A request's view of the pool: its block table and valid length.
+
+    ``block_ids[i]`` backs logical positions ``[i*bs, (i+1)*bs)``;
+    ``shared_tokens`` is the prefix length served from the trie at
+    acquire time (those blocks arrived with KV already written).
+    """
+
+    __slots__ = ("block_ids", "num_tokens", "shared_tokens")
+
+    def __init__(self, block_ids: List[int], num_tokens: int,
+                 shared_tokens: int):
+        self.block_ids = block_ids
+        self.num_tokens = num_tokens
+        self.shared_tokens = shared_tokens
+
+
+class PagedKVCache:
+    """Block pool + block tables + prefix trie for paged decode."""
+
+    def __init__(self, num_blocks: int, block_size: int, layers: int,
+                 kv_heads: int, head_dim: int, dtype=jnp.bfloat16,
+                 share_prefixes: bool = True, registry=None,
+                 name: str = "pool0"):
+        if num_blocks < 2:
+            raise ValueError("num_blocks must be >= 2 (block 0 is the "
+                             "reserved garbage block)")
+        if block_size < 1:
+            raise ValueError("block_size must be positive")
+        self.data = jnp.zeros(
+            (num_blocks, layers, 2, block_size, kv_heads, head_dim), dtype)
+        self.block_size = block_size
+        self.share_prefixes = share_prefixes
+        self.name = name
+        # block 0 is reserved: never allocated, never freed
+        self._ref = np.zeros((num_blocks,), np.int32)
+        self._ref[0] = 1
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+        self._root = _TrieNode((), 0, None)  # sentinel; holds no block
+        self._clock = 0
+        self.prefix_hit_tokens = 0
+        self.prefix_lookup_tokens = 0
+        self.evicted_blocks = 0
+        self.cow_copies = 0
+        self._g_free = self._g_used = self._g_shared = None
+        self._c_hits = self._c_evict = self._c_cow = None
+        if registry is not None:
+            self._g_free = registry.gauge(
+                "serving_paged_blocks_free", "free pool blocks", ["cache"])
+            self._g_used = registry.gauge(
+                "serving_paged_blocks_used", "allocated pool blocks",
+                ["cache"])
+            self._g_shared = registry.gauge(
+                "serving_paged_blocks_shared",
+                "blocks referenced more than once (prefix sharing / COW)",
+                ["cache"])
+            self._c_hits = registry.counter(
+                "serving_paged_prefix_hit_tokens_total",
+                "prompt tokens served from the prefix trie", ["cache"])
+            self._c_evict = registry.counter(
+                "serving_paged_evicted_blocks_total",
+                "cached prefix blocks reclaimed under memory pressure",
+                ["cache"])
+            self._c_cow = registry.counter(
+                "serving_paged_cow_total", "copy-on-write block copies",
+                ["cache"])
+        self._update_gauges()
+
+    # -- accounting ----------------------------------------------------------
+
+    @property
+    def num_blocks(self) -> int:
+        return self.data.shape[0]
+
+    @property
+    def usable_blocks(self) -> int:
+        return self.num_blocks - 1          # minus the garbage block
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return self.usable_blocks - len(self._free)
+
+    @property
+    def shared_blocks(self) -> int:
+        return int(np.sum(self._ref[1:] > 1))
+
+    @property
+    def block_bytes(self) -> int:
+        return int(np.prod(self.data.shape[1:])) * self.data.dtype.itemsize
+
+    def free_bytes(self) -> int:
+        return self.free_blocks * self.block_bytes
+
+    def used_bytes(self) -> int:
+        return self.used_blocks * self.block_bytes
+
+    def occupancy(self) -> float:
+        return self.used_blocks / self.usable_blocks
+
+    def stats(self) -> Dict[str, Any]:
+        return {"free_blocks": self.free_blocks,
+                "used_blocks": self.used_blocks,
+                "shared_blocks": self.shared_blocks,
+                "prefix_hit_tokens": self.prefix_hit_tokens,
+                "prefix_lookup_tokens": self.prefix_lookup_tokens,
+                "evicted_blocks": self.evicted_blocks,
+                "cow_copies": self.cow_copies}
+
+    def _update_gauges(self) -> None:
+        if self._g_free is not None:
+            self._g_free.set(self.free_blocks, cache=self.name)
+            self._g_used.set(self.used_blocks, cache=self.name)
+            self._g_shared.set(self.shared_blocks, cache=self.name)
+
+    # -- block-level plumbing ------------------------------------------------
+
+    def blocks_for(self, n_tokens: int) -> int:
+        return -(-n_tokens // self.block_size)
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    def _alloc_block(self) -> int:
+        bid = self._free.pop()
+        self._ref[bid] = 1
+        return bid
+
+    def _deref(self, bid: int) -> None:
+        self._ref[bid] -= 1
+        if self._ref[bid] == 0:
+            self._free.append(bid)
+
+    def _reserve(self, n: int) -> bool:
+        """Make ``n`` blocks available, evicting cached prefixes LRU-first
+        if the free list is short.  False when even a fully-drained trie
+        cannot supply them."""
+        while len(self._free) < n:
+            if not self._evict_one():
+                return False
+        return True
+
+    def _evict_one(self) -> bool:
+        """Drop the least-recently-matched trie *leaf* whose block is held
+        only by the trie.  Leaf-first ordering means a parent is never
+        reclaimed under a live child (a sequence using the child also
+        refs the parent, so the parent is never trie-only first)."""
+        victim = None
+        stack = [self._root]
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            if (node is not self._root and not node.children
+                    and self._ref[node.block] == 1):
+                if victim is None or node.stamp < victim.stamp:
+                    victim = node
+        if victim is None:
+            return False
+        del victim.parent.children[victim.key]
+        self._deref(victim.block)
+        self.evicted_blocks += 1
+        if self._c_evict is not None:
+            self._c_evict.inc(cache=self.name)
+        return True
+
+    # -- sequence lifecycle --------------------------------------------------
+
+    def acquire(self, tokens: Sequence[int]) -> Optional[PagedSequence]:
+        """Claim blocks for a context of ``tokens``.
+
+        Matches the longest full-block prefix in the trie (capped so at
+        least one context token is left for the caller to actually run —
+        a fully-cached context would yield no logits to sample from),
+        then allocates fresh exclusive blocks for the rest.  Returns
+        None when the pool cannot supply them even after eviction; the
+        caller is expected to requeue and retry.  Shared blocks already
+        hold their KV — :meth:`write_context_kv` skips them.
+        """
+        n = len(tokens)
+        if n < 1:
+            raise ValueError("cannot acquire an empty context")
+        bs = self.block_size
+        shared: List[int] = []
+        if self.share_prefixes:
+            node = self._root
+            for i in range((n - 1) // bs):
+                child = node.children.get(tuple(tokens[i * bs:(i + 1) * bs]))
+                if child is None:
+                    break
+                child.stamp = self._tick()
+                shared.append(child.block)
+                node = child
+        shared_tokens = len(shared) * bs
+        fresh_needed = self.blocks_for(n - shared_tokens)
+        if not self._reserve(fresh_needed):
+            return None
+        blocks = shared + [self._alloc_block() for _ in range(fresh_needed)]
+        for bid in shared:
+            self._ref[bid] += 1
+        self.prefix_hit_tokens += shared_tokens
+        self.prefix_lookup_tokens += n
+        if self._c_hits is not None and shared_tokens:
+            self._c_hits.inc(shared_tokens, cache=self.name)
+        self._update_gauges()
+        return PagedSequence(blocks, n, shared_tokens)
+
+    def register_prefix(self, seq: PagedSequence,
+                        tokens: Sequence[int]) -> None:
+        """Publish ``seq``'s full context blocks into the trie so later
+        requests with the same prompt prefix share them.  Call after the
+        blocks' KV is written.  Each newly-published node takes one trie
+        reference, which is what keeps the KV alive after ``seq``
+        finishes."""
+        if not self.share_prefixes:
+            return
+        bs = self.block_size
+        node = self._root
+        for i in range(len(tokens) // bs):
+            key = tuple(tokens[i * bs:(i + 1) * bs])
+            child = node.children.get(key)
+            if child is None:
+                child = _TrieNode(key, seq.block_ids[i], node)
+                node.children[key] = child
+                self._ref[seq.block_ids[i]] += 1
+            child.stamp = self._tick()
+            node = child
+        self._update_gauges()
+
+    def release(self, seq: PagedSequence) -> None:
+        """Drop ``seq``'s references.  Trie-published blocks stay cached
+        (the trie holds its own reference); exclusive blocks return to
+        the free list."""
+        for bid in seq.block_ids:
+            self._deref(bid)
+        seq.block_ids = []
+        seq.num_tokens = 0
+        self._update_gauges()
+
+    def ensure_capacity(self, seq: PagedSequence, n_tokens: int) -> bool:
+        """Grow ``seq``'s table to cover ``n_tokens`` logical positions
+        (fresh exclusive blocks).  False when the pool is exhausted."""
+        need = self.blocks_for(n_tokens) - len(seq.block_ids)
+        if need <= 0:
+            return True
+        if not self._reserve(need):
+            return False
+        seq.block_ids.extend(self._alloc_block() for _ in range(need))
+        self._update_gauges()
+        return True
+
+    def ensure_writable(self, seq: PagedSequence, block_index: int) -> int:
+        """Copy-on-write: make ``seq.block_ids[block_index]`` exclusively
+        owned before a write.  No-op (refcount already 1) on the normal
+        serve path; a forked sequence pays one block copy here.  Returns
+        the (possibly new) block id; raises MemoryError when the pool
+        cannot supply the copy."""
+        bid = seq.block_ids[block_index]
+        if self._ref[bid] == 1:
+            return bid
+        if not self._reserve(1):
+            raise MemoryError("pool exhausted during copy-on-write")
+        new = self._alloc_block()
+        self.data = self.data.at[new].set(self.data[bid])
+        self._ref[bid] -= 1
+        seq.block_ids[block_index] = new
+        self.cow_copies += 1
+        if self._c_cow is not None:
+            self._c_cow.inc(cache=self.name)
+        self._update_gauges()
+        return new
+
+    def fork(self, seq: PagedSequence) -> Optional[PagedSequence]:
+        """Clone ``seq`` sharing every block (parallel sampling: n
+        continuations of one prompt).  Writers must call
+        :meth:`ensure_writable` on the tail block before appending —
+        that is where the copy-on-write actually triggers."""
+        for bid in seq.block_ids:
+            self._ref[bid] += 1
+        self._update_gauges()
+        return PagedSequence(list(seq.block_ids), seq.num_tokens,
+                             seq.shared_tokens)
+
+    # -- KV movement ---------------------------------------------------------
+
+    def write_context_kv(self, seq: PagedSequence, kv,
+                         context_len: int) -> None:
+        """Install prefilled KV into ``seq``'s *exclusive* blocks.
+
+        ``kv``: ``(layers, 2, s, kv_heads, head_dim)`` for one sequence
+        (``s`` may be bucket-padded beyond ``context_len``).  The shared
+        prefix ``[0, seq.shared_tokens)`` is skipped — those blocks
+        already hold bitwise-identical KV from the prefill that
+        published them, which is precisely the dedup win.
+        """
+        bs = self.block_size
+        start = seq.shared_tokens        # block-aligned by construction
+        if context_len <= start:
+            return
+        full_end = (context_len // bs) * bs
+        if full_end > start:
+            ids = np.asarray(seq.block_ids[start // bs:full_end // bs])
+            sl = kv[:, :, start:full_end].astype(self.data.dtype)
+            lyr, two = sl.shape[0], sl.shape[1]
+            sl = sl.reshape(lyr, two, len(ids), bs, *sl.shape[3:])
+            self.data = self.data.at[ids].set(sl.transpose(2, 0, 1, 3, 4, 5))
+        rem = context_len - full_end
+        if rem > 0:
+            bid = seq.block_ids[full_end // bs]
+            self.data = self.data.at[bid, :, :, :rem].set(
+                kv[:, :, full_end:context_len].astype(self.data.dtype))
+
+    def table_row(self, seq: Optional[PagedSequence],
+                  max_blocks: int) -> np.ndarray:
+        """``seq``'s block table padded with the garbage block (0) —
+        also the whole row for an inactive slot, so stray decode writes
+        land in garbage instead of a live block."""
+        row = np.zeros((max_blocks,), np.int32)
+        if seq is not None:
+            row[:len(seq.block_ids)] = seq.block_ids
+        return row

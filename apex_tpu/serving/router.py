@@ -1,0 +1,156 @@
+"""SLO-aware multi-replica router: admission, placement, shedding.
+
+One :class:`Router` fronts N independent engine replicas (contiguous or
+paged — anything with the :class:`~apex_tpu.inference.InferenceEngine`
+surface).  Placement and admission read two signals per replica:
+
+* **queue pressure** — ``queue_depth + active_requests``, the classic
+  least-loaded signal; a replica at ``max_queue_depth`` queued requests
+  is ineligible outright (its own bounded queue would reject anyway —
+  the router just refuses earlier and cheaper).
+* **SLO burn rate** — ``max`` over the replica's
+  :class:`~apex_tpu.observability.slo.SLOTarget`\\ s of the short-window
+  error-budget burn (:meth:`SLOMonitor.burn_rate`).  A replica burning
+  ≥ ``burn_threshold`` with ANY backlog is ineligible: it is already
+  missing its latency objectives, so adding load converts one slow
+  replica into globally blown SLOs.  (Burn with an EMPTY queue does not
+  shed — an idle replica's stale burn history should not refuse the
+  request that would be served instantly.)
+
+When every replica is ineligible the request is SHED —
+:class:`RequestShed` raised to the caller, who got an answer in
+microseconds instead of a timeout in seconds.  Shedding is the SLO
+mechanism, not a failure: dropping the marginal request is what keeps
+the admitted ones inside their objectives (the loadgen's ``--overload``
+runs demonstrate exactly this trade).
+
+Scheduling stays host-side and cooperative: :meth:`step` advances every
+replica one engine tick (round-robin), :meth:`run` drives to drain.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from apex_tpu.inference.engine import QueueFull, Request, Response
+
+
+class RequestShed(RuntimeError):
+    """Every replica was overloaded; the request was refused at the
+    door.  Callers retry with backoff or surface 429/503."""
+
+
+class Router:
+    """SLO-aware admission over a set of engine replicas."""
+
+    def __init__(self, replicas: Sequence, *,
+                 max_queue_depth: int = 8,
+                 burn_threshold: float = 14.4,
+                 burn_window_s: float = 60.0,
+                 registry=None):
+        if not replicas:
+            raise ValueError("need at least one replica")
+        if max_queue_depth < 1:
+            raise ValueError("max_queue_depth must be >= 1")
+        self.replicas = list(replicas)
+        self.max_queue_depth = max_queue_depth
+        self.burn_threshold = burn_threshold
+        self.burn_window_s = burn_window_s
+        self.shed_requests = 0
+        r = registry if registry is not None \
+            else self.replicas[0].metrics.registry
+        self._c_submitted = r.counter(
+            "router_submitted_total", "requests placed, by replica",
+            labelnames=("replica",))
+        self._c_shed = r.counter(
+            "router_shed_total",
+            "requests refused with every replica overloaded")
+        self._g_depth = r.gauge(
+            "router_queue_depth", "replica queue depth at placement",
+            labelnames=("replica",))
+        self._g_burn = r.gauge(
+            "router_burn_rate",
+            "replica max short-window SLO burn at placement",
+            labelnames=("replica",))
+
+    # -- signals -------------------------------------------------------------
+
+    def _burn(self, engine) -> float:
+        """Max short-window burn across the replica's SLO targets (0.0
+        when the replica has no SLO monitor attached)."""
+        slo = getattr(engine.metrics, "slo", None)
+        if slo is None or not slo.targets:
+            return 0.0
+        return max(slo.burn_rate(t, self.burn_window_s)
+                   for t in slo.targets)
+
+    def _overloaded(self, engine, burn: float) -> bool:
+        if engine.queue_depth >= self.max_queue_depth:
+            return True
+        return burn >= self.burn_threshold and engine.queue_depth >= 1
+
+    # -- admission -----------------------------------------------------------
+
+    def submit(self, request: Request) -> int:
+        """Place ``request`` on the best eligible replica; returns the
+        replica index.  Raises :class:`RequestShed` when no replica is
+        eligible (including the race where an eligible replica's own
+        bounded queue filled concurrently — :class:`QueueFull` just
+        moves on to the next candidate)."""
+        scored = []
+        for i, eng in enumerate(self.replicas):
+            burn = self._burn(eng)
+            self._g_depth.set(eng.queue_depth, replica=str(i))
+            self._g_burn.set(burn, replica=str(i))
+            if self._overloaded(eng, burn):
+                continue
+            scored.append((eng.queue_depth + eng.active_requests, burn, i))
+        for _, _, i in sorted(scored):
+            try:
+                self.replicas[i].submit(request)
+            except QueueFull:
+                continue
+            self._c_submitted.inc(replica=str(i))
+            return i
+        self.shed_requests += 1
+        self._c_shed.inc()
+        raise RequestShed(
+            f"all {len(self.replicas)} replicas overloaded "
+            f"(max_queue_depth={self.max_queue_depth}, "
+            f"burn_threshold={self.burn_threshold})")
+
+    # -- scheduling ----------------------------------------------------------
+
+    def step(self) -> bool:
+        """Advance every replica one engine tick; True while any has
+        (or may have) work."""
+        busy = False
+        for eng in self.replicas:
+            busy = eng.step() or busy
+        return busy
+
+    def run(self, max_steps: Optional[int] = None) -> List[Response]:
+        """Drive :meth:`step` to drain (or ``max_steps``); returns all
+        completed responses across replicas."""
+        steps = 0
+        while any(e._queue or e._active for e in self.replicas):
+            self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        return self.completed
+
+    @property
+    def queue_depth(self) -> int:
+        return sum(e.queue_depth for e in self.replicas)
+
+    @property
+    def active_requests(self) -> int:
+        return sum(e.active_requests for e in self.replicas)
+
+    @property
+    def completed(self) -> List[Response]:
+        out: List[Response] = []
+        for eng in self.replicas:
+            out.extend(eng.completed)
+        return out

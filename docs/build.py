@@ -29,7 +29,8 @@ sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 PACKAGES = ["apex_tpu.amp", "apex_tpu.optimizers", "apex_tpu.transformer",
             "apex_tpu.parallel", "apex_tpu.inference",
-            "apex_tpu.resilience", "apex_tpu.observability"]
+            "apex_tpu.serving", "apex_tpu.resilience",
+            "apex_tpu.observability"]
 
 _PAGE = """<!doctype html>
 <html><head><meta charset="utf-8"><title>{title}</title>

@@ -237,6 +237,25 @@ class TestKVCache:
         with pytest.raises(ValueError):
             c.write_prompt(0, jnp.zeros((2, 2, 8, 2, 4)), 0)  # empty
 
+    def test_byte_accounting(self, rng):
+        """free_bytes is slot-granular (allocatable capacity);
+        used_bytes/occupancy are token-granular (valid entries) — the
+        gap between them is the internal fragmentation the paged cache
+        exists to remove."""
+        c = self._cache(2)                       # 2 slots x 8 positions
+        assert c.free_bytes() == 2 * c.slot_bytes
+        assert c.used_bytes() == 0 and c.occupancy() == 0.0
+        slot = c.allocate()
+        c.write_prompt(slot, jnp.asarray(rng.randn(2, 2, 8, 2, 4),
+                                         jnp.float32), length=4)
+        assert c.free_bytes() == 1 * c.slot_bytes
+        assert c.used_bytes() == c.slot_bytes // 2   # 4 of 8 positions
+        assert c.occupancy() == pytest.approx(4 / 16)
+        c.advance(slot)
+        assert c.occupancy() == pytest.approx(5 / 16)
+        c.free(slot)
+        assert c.free_bytes() == 2 * c.slot_bytes and c.occupancy() == 0.0
+
 
 # -- sampling ----------------------------------------------------------------
 
@@ -256,11 +275,48 @@ class TestSampling:
                  for i in range(32)}
         assert draws <= {0, 1} and len(draws) == 2
 
+    def test_top_p_restricts_to_nucleus(self):
+        # probs ~ [0.64, 0.24, 0.09, 0.03]: a 0.7 nucleus keeps the top
+        # two (the crossing token is included), never tokens 2 or 3
+        logits = jnp.asarray([4.0, 3.0, 2.0, 1.0])
+        p = SamplingParams(temperature=1.0, top_p=0.7)
+        draws = {int(sample(logits, p, jax.random.PRNGKey(i)))
+                 for i in range(64)}
+        assert draws <= {0, 1} and len(draws) == 2
+
+    def test_top_p_always_keeps_one_token(self):
+        # a tiny nucleus still samples: the argmax survives even when
+        # its probability alone exceeds top_p
+        logits = jnp.asarray([10.0, 0.0, 0.0, 0.0])
+        p = SamplingParams(temperature=1.0, top_p=0.01)
+        assert all(int(sample(logits, p, jax.random.PRNGKey(i))) == 0
+                   for i in range(8))
+
+    def test_top_p_composes_with_top_k(self):
+        # k=3 keeps {0,1,2}; the 0.75 nucleus over the survivors' mass
+        # then drops token 2 as well
+        logits = jnp.asarray([4.0, 3.0, 2.0, 1.9])
+        p = SamplingParams(temperature=1.0, top_k=3, top_p=0.75)
+        draws = {int(sample(logits, p, jax.random.PRNGKey(i)))
+                 for i in range(64)}
+        assert draws <= {0, 1}
+
+    def test_top_p_one_is_full_vocab(self):
+        logits = jnp.asarray([0.0, 0.1, 0.2, 0.3])
+        p = SamplingParams(temperature=5.0, top_p=1.0)
+        draws = {int(sample(logits, p, jax.random.PRNGKey(i)))
+                 for i in range(128)}
+        assert draws == {0, 1, 2, 3}
+
     def test_invalid_params(self):
         with pytest.raises(ValueError):
             SamplingParams(temperature=-1.0)
         with pytest.raises(ValueError):
             SamplingParams(top_k=0)
+        with pytest.raises(ValueError):
+            SamplingParams(top_p=0.0)
+        with pytest.raises(ValueError):
+            SamplingParams(top_p=1.5)
 
 
 # -- continuous-batching engine ----------------------------------------------
