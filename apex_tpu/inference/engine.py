@@ -118,9 +118,28 @@ class InferenceEngine:
                  metrics: Optional[ServingMetrics] = None,
                  registry=None, tracer=None,
                  min_prompt_bucket: int = 8,
-                 max_queue: Optional[int] = None):
+                 max_queue: Optional[int] = None,
+                 plan=None):
         model._check_decode_supported()
         cfg = model.cfg
+        if plan is not None:
+            # decode runs one stage deep and token-at-a-time: of the
+            # plan, only the tp degree applies, and it must match the
+            # model the engine was handed
+            if plan.pp > 1:
+                raise ValueError(
+                    f"serving does not pipeline: plan.pp={plan.pp}")
+            if plan.sequence_parallel:
+                raise ValueError(
+                    "sequence_parallel shards the seq axis the decode "
+                    "path appends to; serve with sequence_parallel=False")
+            if plan.tp != cfg.tensor_parallel_size:
+                raise ValueError(
+                    f"plan.tp={plan.tp} does not match the model's "
+                    f"tensor_parallel_size={cfg.tensor_parallel_size}; "
+                    "build the model from the same plan "
+                    "(GPTConfig(plan=plan))")
+        self.plan = plan
         self.model = model
         self.params = params
         self.clock = clock

@@ -49,8 +49,14 @@ class BertConfig:
     remat_policy: str = "full"                 # "full" | "dots" (selective)
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
+    # one validated ParallelPlan instead of the per-knob kwargs above
+    # (see GPTConfig.plan — same supersede-with-warning semantics)
+    plan: Optional[object] = None
 
     def __post_init__(self):
+        if self.plan is not None:
+            from apex_tpu.parallel.plan import apply_plan_to_config
+            apply_plan_to_config(self)
         if self.ffn_hidden_size is None:
             self.ffn_hidden_size = 4 * self.hidden_size
         if self.remat_policy not in ("full", "dots"):

@@ -81,8 +81,16 @@ class GPTConfig:
     remat_policy: str = "full"                 # "full" | "dots" (selective)
     dtype: jnp.dtype = jnp.float32             # activation/compute dtype
     param_dtype: jnp.dtype = jnp.float32
+    # one validated ParallelPlan instead of the per-knob kwargs above:
+    # tp/SP/overlap/remat knobs are filled from it (plan wins on
+    # conflict, with a DeprecationWarning); dp/pp/schedule fields are
+    # consumed by the optimizer/pipeline layers, not the config
+    plan: Optional[object] = None
 
     def __post_init__(self):
+        if self.plan is not None:
+            from apex_tpu.parallel.plan import apply_plan_to_config
+            apply_plan_to_config(self)
         if self.ffn_hidden_size is None:
             self.ffn_hidden_size = 4 * self.hidden_size
         if self.hidden_size % self.num_attention_heads:

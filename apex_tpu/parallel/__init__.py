@@ -17,8 +17,11 @@ from apex_tpu.parallel.sync_batchnorm import (
     convert_syncbn_model,
 )
 from apex_tpu.parallel.LARC import LARC, larc
+from apex_tpu.parallel.plan import PLAN_VERSION, ParallelPlan
 
 __all__ = [
+    "PLAN_VERSION",
+    "ParallelPlan",
     "DistributedDataParallel",
     "Reducer",
     "allreduce_gradients",

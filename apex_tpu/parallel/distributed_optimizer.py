@@ -63,8 +63,37 @@ class _DistributedMixin:
     # the single-chip base default is per-leaf
     _default_bucketed = True
 
+    @staticmethod
+    def _resolve_plan(plan, world_size, allreduce_dtype):
+        """Fold a :class:`~apex_tpu.parallel.plan.ParallelPlan` into the
+        ctor's per-knob args.  The per-knob kwargs stay the back-compat
+        surface (silent without a plan); a non-default knob that
+        CONFLICTS with the attached plan is superseded — the plan wins
+        and a DeprecationWarning names it."""
+        if plan is None:
+            return world_size, allreduce_dtype
+        import warnings
+        kw = plan.optimizer_kwargs()
+        if world_size != 1 and world_size != kw["world_size"]:
+            warnings.warn(
+                f"world_size={world_size} is superseded by the attached "
+                f"ParallelPlan (zero_shard={kw['world_size']}); set "
+                "zero_shard on the plan instead", DeprecationWarning,
+                stacklevel=3)
+        if allreduce_dtype is not None \
+                and allreduce_dtype != kw["allreduce_dtype"]:
+            warnings.warn(
+                f"allreduce_dtype={allreduce_dtype!r} is superseded by "
+                f"the attached ParallelPlan "
+                f"({kw['allreduce_dtype']!r})", DeprecationWarning,
+                stacklevel=3)
+        return kw["world_size"], kw["allreduce_dtype"]
+
     def _dist_init(self, world_size, axis_name, average_grads,
-                   allreduce_dtype=None):
+                   allreduce_dtype=None, plan=None):
+        world_size, allreduce_dtype = self._resolve_plan(
+            plan, world_size, allreduce_dtype)
+        self.plan = plan
         if world_size < 1:
             raise ValueError("world_size must be >= 1")
         self.world_size = int(world_size)
@@ -314,15 +343,18 @@ class DistributedFusedAdam(_DistributedMixin, FusedAdam):
     run ``init``/``step`` inside ``shard_map`` over the data axis.
     ``allreduce_dtype`` in ``{None/'f32', 'bf16', 'int8'}`` selects the
     gradient reduce-scatter transport (see
-    :mod:`apex_tpu.utils.compressed_allreduce`).
+    :mod:`apex_tpu.utils.compressed_allreduce`).  ``plan`` (a
+    :class:`~apex_tpu.parallel.plan.ParallelPlan`) supplies
+    ``world_size``/``allreduce_dtype`` from its
+    ``zero_shard``/transport fields instead.
     """
 
     def __init__(self, params=None, lr=1e-3, world_size=1,
                  axis_name="data", average_grads=True,
-                 allreduce_dtype=None, **kw):
+                 allreduce_dtype=None, plan=None, **kw):
         super().__init__(params, lr=lr, **kw)
         self._dist_init(world_size, axis_name, average_grads,
-                        allreduce_dtype)
+                        allreduce_dtype, plan=plan)
 
 
 class DistributedFusedLAMB(_DistributedMixin, FusedLAMB):
@@ -335,16 +367,17 @@ class DistributedFusedLAMB(_DistributedMixin, FusedLAMB):
     per-ROW partial sums (tiny: ``rows × 1``) are all-gathered and reduced
     against the full row→tensor map, then the ratios are applied to the
     local rows only (apex: clip-after-allreduce + two-stage
-    ``multi_tensor_lamb``).  ``allreduce_dtype`` selects the gradient
-    reduce-scatter transport, same as :class:`DistributedFusedAdam`.
+    ``multi_tensor_lamb``).  ``allreduce_dtype``/``plan`` select the
+    gradient reduce-scatter transport and shard factor, same as
+    :class:`DistributedFusedAdam`.
     """
 
     def __init__(self, params=None, lr=1e-3, world_size=1,
                  axis_name="data", average_grads=True,
-                 allreduce_dtype=None, **kw):
+                 allreduce_dtype=None, plan=None, **kw):
         super().__init__(params, lr=lr, **kw)
         self._dist_init(world_size, axis_name, average_grads,
-                        allreduce_dtype)
+                        allreduce_dtype, plan=plan)
 
     def _pre_step_sharded(self, layout, packed_local, state, *, lr,
                           grad_scale):

@@ -121,10 +121,15 @@ class CheckpointManager:
     re-plan) is stamped into each manifest together with the mesh
     shape, so a restart can tell which layout a checkpoint's arrays are
     partitioned for BEFORE deserializing them into the wrong one.
+
+    ``parallel_plan`` (a :class:`~apex_tpu.parallel.plan.ParallelPlan`
+    or its dict form) is stamped under its own manifest key; the
+    ``topology`` key keeps its original schema so manifests written by
+    older versions of this module round-trip unchanged.
     """
 
     def __init__(self, directory: str, *, keep: int = 2, threads: int = 4,
-                 fault_injector=None, topology=None):
+                 fault_injector=None, topology=None, parallel_plan=None):
         if keep < 1:
             raise ValueError("keep must be >= 1")
         self.directory = str(directory)
@@ -132,6 +137,7 @@ class CheckpointManager:
         self.threads = int(threads)
         self.fault_injector = fault_injector
         self.topology = topology
+        self.parallel_plan = parallel_plan
         os.makedirs(self.directory, exist_ok=True)
         self._pending: list = []          # [(step, thread, box)]
         self._lock = threading.Lock()
@@ -141,6 +147,12 @@ class CheckpointManager:
         if t is None:
             return None
         return t.to_dict() if hasattr(t, "to_dict") else dict(t)
+
+    def _plan_dict(self) -> Optional[dict]:
+        p = self.parallel_plan
+        if p is None:
+            return None
+        return p.to_dict() if hasattr(p, "to_dict") else dict(p)
 
     # -- enumeration --------------------------------------------------------
 
@@ -167,6 +179,17 @@ class CheckpointManager:
         try:
             with open(mpath) as f:
                 return json.load(f).get("topology")
+        except (OSError, ValueError):
+            return None
+
+    def plan_of(self, step: int) -> Optional[dict]:
+        """The full parallel-plan dict stamped into ``step``'s manifest
+        (``None`` for checkpoints saved before plans existed or without
+        one) — manifest-only, like :meth:`topology_of`."""
+        mpath = os.path.join(self.directory, _step_dirname(step), _MANIFEST)
+        try:
+            with open(mpath) as f:
+                return json.load(f).get("parallel_plan")
         except (OSError, ValueError):
             return None
 
@@ -206,6 +229,9 @@ class CheckpointManager:
             manifest["mesh_shape"] = {"data": topo.get("dp", 1),
                                       "pipe": topo.get("pp", 1),
                                       "model": topo.get("tp", 1)}
+        plan = self._plan_dict()
+        if plan is not None:
+            manifest["parallel_plan"] = plan
 
         final = os.path.join(self.directory, _step_dirname(step))
         tmp = final + ".tmp"

@@ -118,6 +118,15 @@ class TopologySpec:
                 f"sp={'on' if self.sequence_parallel else 'off'} "
                 f"zero={self.zero_shard}")
 
+    def to_plan(self, **overrides):
+        """Lift into the full :class:`~apex_tpu.parallel.plan.
+        ParallelPlan` this spec is a projection of; ``overrides``
+        supply the knobs the spec does not carry (schedule, remat,
+        transport).  ``spec.to_plan().topology() == spec`` — the
+        lossless round-trip old stamped manifests rely on."""
+        from apex_tpu.parallel.plan import ParallelPlan
+        return ParallelPlan.from_topology(self, **overrides)
+
 
 @dataclasses.dataclass(frozen=True)
 class ElasticPlan:
@@ -132,10 +141,19 @@ class ElasticPlan:
     """
     spec: TopologySpec
     mesh: Any                      # jax.sharding.Mesh
+    parallel: Any = None           # full ParallelPlan when built from one
 
     @classmethod
-    def build(cls, spec: TopologySpec, devices=None) -> "ElasticPlan":
+    def build(cls, spec, devices=None) -> "ElasticPlan":
+        """``spec`` is a :class:`TopologySpec` or a full
+        :class:`~apex_tpu.parallel.plan.ParallelPlan` — the latter is
+        kept on :attr:`parallel` so factories can read the schedule/
+        remat/transport knobs the topology projection drops."""
         import jax
+        parallel = None
+        if not isinstance(spec, TopologySpec) and hasattr(spec, "topology"):
+            parallel = spec
+            spec = spec.topology()
         devices = list(devices) if devices is not None else jax.devices()
         n = spec.n_devices
         if len(devices) < n:
@@ -145,7 +163,7 @@ class ElasticPlan:
         mesh = jax.make_mesh((spec.dp, spec.pp, spec.tp),
                              (_DATA_AXIS, _PIPE_AXIS, _TENSOR_AXIS),
                              devices=devices[:n])
-        return cls(spec=spec, mesh=mesh)
+        return cls(spec=spec, mesh=mesh, parallel=parallel)
 
     def replicated(self):
         from jax.sharding import NamedSharding, PartitionSpec
@@ -399,11 +417,12 @@ class ElasticSignal(collections.namedtuple("ElasticSignal",
     SIGTERM-with-grace analogue) or ``"replan"`` (re-shard onto
     ``spec`` and keep training — the arrival/defrag analogue)."""
 
-    def __new__(cls, kind: str, spec: Optional[TopologySpec] = None):
+    def __new__(cls, kind: str, spec=None):
         if kind not in ("preempt", "replan"):
             raise ValueError(f"unknown signal kind {kind!r}")
         if kind == "replan" and spec is None:
-            raise ValueError("replan signals need a target TopologySpec")
+            raise ValueError("replan signals need a target TopologySpec "
+                             "or ParallelPlan")
         return super().__new__(cls, kind, spec)
 
 
@@ -429,7 +448,10 @@ class HostSignals:
     def request_preempt(self) -> None:
         self.request(ElasticSignal("preempt"))
 
-    def request_replan(self, spec: TopologySpec) -> None:
+    def request_replan(self, spec) -> None:
+        """``spec`` is a :class:`TopologySpec` or a full
+        :class:`~apex_tpu.parallel.plan.ParallelPlan` (e.g. the winner
+        ``tools/autotune.py`` emitted)."""
         self.request(ElasticSignal("replan", spec))
 
     def poll(self) -> Optional[ElasticSignal]:
@@ -530,7 +552,7 @@ class ElasticTrainer:
                          else list(plan.mesh.devices.flat))
         self.checkpoint = CheckpointManager(
             directory, keep=keep, fault_injector=fault_injector,
-            topology=plan.spec)
+            topology=plan.spec, parallel_plan=plan.parallel)
         self._comp: Optional[ElasticComponents] = None
         self._params = self._opt = self._gstate = self._sstate = None
         self._preempt_requested = False
@@ -661,12 +683,15 @@ class ElasticTrainer:
                     template, topology=self.plan.spec)
                 self._adopt(old_comp, restored)
                 step = int(np.asarray(restored["step"]))
-                self._replan(self.plan.spec, step, from_plan=old_plan,
+                target = (self.plan.parallel
+                          if self.plan.parallel is not None
+                          else self.plan.spec)
+                self._replan(target, step, from_plan=old_plan,
                              checkpoint_first=False)
         self._resumed_at(step)
         return step
 
-    def _replan(self, new_spec: TopologySpec, step: int, *,
+    def _replan(self, new_spec, step: int, *,
                 from_plan: Optional[ElasticPlan] = None,
                 checkpoint_first: bool = True) -> None:
         t0 = self.clock()
@@ -683,7 +708,8 @@ class ElasticTrainer:
                 self._save(step)
             t_ck = self.clock()
             new_plan = ElasticPlan.build(new_spec, devices=self._devices)
-            self.checkpoint.topology = new_spec
+            self.checkpoint.topology = new_plan.spec
+            self.checkpoint.parallel_plan = new_plan.parallel
             new_comp = self._build(new_plan)
             self._reshard_onto(old_plan, old_comp, new_plan, new_comp)
             self._comp, self.plan = new_comp, new_plan
@@ -715,7 +741,7 @@ class ElasticTrainer:
         zero = new_dp if cur.zero_shard > 1 else 1
         return dataclasses.replace(cur, dp=new_dp, zero_shard=zero)
 
-    def _poll_signals(self, step: int) -> Optional[TopologySpec]:
+    def _poll_signals(self, step: int):
         target = None
         inj = self.fault_injector
         if inj is not None:

@@ -97,7 +97,8 @@ class GuardedTrainStep:
                  scaler=None, spike_factor: float = 10.0,
                  ema_decay: float = 0.99, warmup_steps: int = 5,
                  max_consecutive: int = 3, checkpoint=None,
-                 fault_injector=None, lr=None, donate: bool = False):
+                 fault_injector=None, lr=None, donate: bool = False,
+                 plan=None):
         if (loss_fn is None) == (grad_fn is None):
             raise ValueError("pass exactly one of loss_fn / grad_fn")
         if optimizer is None:
@@ -106,6 +107,21 @@ class GuardedTrainStep:
             raise ValueError(
                 "scaler requires the loss_fn form (the guard scales the "
                 "loss before autodiff); with grad_fn, scale inside it")
+        # `plan` (a ParallelPlan) declares the layout this step's state
+        # lives under — the elastic layer stamps it into checkpoint
+        # manifests alongside the topology.  The one cross-check the
+        # guard can make locally: a ZeRO optimizer's shard factor must
+        # match the plan's zero_shard
+        self.plan = plan
+        if plan is not None:
+            inner = getattr(optimizer, "inner", optimizer)
+            ws = getattr(inner, "world_size", None)
+            if ws is not None and ws != plan.zero_shard:
+                raise ValueError(
+                    f"optimizer world_size={ws} does not match "
+                    f"plan.zero_shard={plan.zero_shard}; build the "
+                    "optimizer from the same plan "
+                    "(DistributedFusedAdam(plan=plan))")
         self.loss_fn = loss_fn
         self.grad_fn = grad_fn
         self.optimizer = optimizer

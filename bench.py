@@ -1500,6 +1500,63 @@ def bench_lint():
             "total_wall_s": round(wall, 3)}
 
 
+def bench_autotune():
+    """Auto-parallel planner leg (ISSUE 11): predicted-vs-measured gap.
+
+    Runs ``tools/autotune.py`` end-to-end on a small GPT over an
+    8-device CPU mesh — enumerate, memory-prune, cost-model rank,
+    measure top-3 — and reports how far the cost model's predictions
+    sit from the wall clock it then measured.  The planner owns its own
+    mesh and this process owns the TPU, so it rides in a subprocess
+    pinned to the host platform, exactly as CI runs it."""
+    import subprocess
+    import sys
+    import tempfile
+
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools", "autotune.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    t0 = time.perf_counter()
+    with tempfile.TemporaryDirectory() as tmp:
+        out_path = os.path.join(tmp, "autotune_plan.json")
+        out = subprocess.run(
+            [sys.executable, script, "--devices", "8", "--out", out_path,
+             "--max-tp", "2", "--max-pp", "2", "--no-zero", "--no-remat",
+             "--quiet"],
+            capture_output=True, text=True, env=env, timeout=1200)
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"autotune failed (exit {out.returncode}): "
+                f"{out.stderr[-1500:]}")
+        with open(out_path) as f:
+            report = json.load(f)
+    wall = time.perf_counter() - t0
+    measured = [c for c in report["candidates"]
+                if c.get("measured_s") is not None]
+    # the gap the cost model owes the user: per measured candidate,
+    # |predicted - measured| / measured
+    gaps = [abs(c["predicted_s"] - c["measured_s"]) / c["measured_s"]
+            for c in measured]
+    ranked = sorted((c for c in report["candidates"]
+                     if c.get("predicted_s") is not None),
+                    key=lambda c: c["predicted_s"])
+    pred_best = ranked[0]["plan"] if ranked else None
+    meas_best = min(measured, key=lambda c: c["measured_s"]) if measured \
+        else None
+    return {"candidates": len(report["candidates"]),
+            "measured": len(measured),
+            "winner": report["plan"],
+            "predicted_s": report.get("predicted_s"),
+            "measured_s": report.get("measured_s"),
+            "gap_mean": round(sum(gaps) / len(gaps), 4) if gaps else None,
+            "gap_max": round(max(gaps), 4) if gaps else None,
+            "predicted_best_is_measured_best": bool(
+                pred_best is not None and meas_best is not None
+                and pred_best == meas_best["plan"]),
+            "total_wall_s": round(wall, 3)}
+
+
 def main():
     backend = jax.default_backend()
     # every leg's result also lands on the metrics registry as one
@@ -1533,6 +1590,7 @@ def main():
     serving_obs = _retry(bench_serving_observability)
     serving_paged = _retry(bench_serving_paged)
     lint_gate = _retry(bench_lint)
+    autotune_leg = _retry(bench_autotune)
     rounded = lambda d: (None if d is None else
                          {k: (round(v, 6) if isinstance(v, float) else v)
                           for k, v in d.items()})
@@ -1563,6 +1621,7 @@ def main():
             "serving_observability": rounded(serving_obs),
             "serving_paged": serving_paged,
             "lint": lint_gate,
+            "autotune": autotune_leg,
         },
     }
     result["metrics_stream"] = stream_path

@@ -1,0 +1,567 @@
+#!/usr/bin/env python
+"""Measured-cost auto-parallel planner (ROADMAP item 1).
+
+Enumerates the joint (dp, tp, pp, sequence-parallel, overlap-chunk,
+virtual-stage, microbatch, remat, ZeRO, transport-dtype) space as
+validated :class:`~apex_tpu.parallel.plan.ParallelPlan` candidates,
+then drives each survivor through three measured gates:
+
+1. **memory prune** — compile the candidate's ACTUAL train step
+   (pipeline + optimizer, the program that would run) and reject it
+   when :func:`apex_tpu.analysis.memory.estimate_peak_memory` exceeds
+   the per-device HBM budget.  No closed-form activation guesses: the
+   estimate walks the lowered HLO's live ranges.
+2. **cost rank** — predicted step time = compute roofline (flops from
+   the 6ND rule, 8ND under remat, calibrated against a matmul timed on
+   THIS host, divided by the pipeline's utilization
+   ``1 - bubble_fraction``) + communication from
+   ``CostModel.predict_stats`` over the candidate's own optimized-HLO
+   collectives, with alpha-beta coefficients fitted from ring
+   microbenchmarks (``tools/comms_probe.py`` profile, or probed
+   in-process when none is given).
+3. **measure** — the top-K ranked candidates run for real under the
+   hard-sync timing protocol; the measured winner is emitted.
+
+The emitted JSON is versioned and round-trips through
+``ParallelPlan.from_dict``; hand ``load_plan(path)`` to
+``HostSignals.request_replan`` and a live ``ElasticTrainer`` re-shards
+onto it without a restart.
+
+Usage:
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+        JAX_PLATFORMS=cpu python tools/autotune.py --devices 8 \\
+        --out plan.json
+    python tools/autotune.py --devices 8 --profile comms_profile.json \\
+        --hbm-gb 0.5 --top-k 3 --out plan.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+from typing import Any, List, Optional, Sequence, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+AUTOTUNE_VERSION = 1
+
+# tiny-GPT default workload: big enough that dp/tp/pp/microbatching all
+# change the lowered program, small enough to compile dozens of
+# candidates on a CPU host
+DEFAULT_MODEL = dict(vocab_size=64, hidden_size=32, num_layers=4,
+                     num_attention_heads=4, max_seq_len=16)
+
+
+@dataclasses.dataclass
+class Candidate:
+    """One point of the search space and everything measured about it.
+
+    ``status`` walks ``enumerated -> built -> ranked -> measured`` or
+    dead-ends at ``rejected`` (invalid knob combination, with the
+    validation error as ``reason``) / ``pruned`` (over the HBM budget)
+    / ``failed`` (compile error — recorded, not fatal)."""
+    plan: Any
+    status: str = "enumerated"
+    reason: str = ""
+    peak_bytes: Optional[int] = None
+    xla_peak_bytes: Optional[int] = None
+    xla_ratio: Optional[float] = None
+    compute_s: Optional[float] = None
+    comm_s: Optional[float] = None
+    predicted_s: Optional[float] = None
+    measured_s: Optional[float] = None
+
+    def to_dict(self) -> dict:
+        d = {"plan": (self.plan.to_dict()
+                      if hasattr(self.plan, "to_dict") else self.plan),
+             "status": self.status}
+        for f in ("reason", "peak_bytes", "xla_peak_bytes", "xla_ratio",
+                  "compute_s", "comm_s", "predicted_s", "measured_s"):
+            v = getattr(self, f)
+            if v not in (None, ""):
+                d[f] = v
+        return d
+
+
+# -- search-space enumeration -------------------------------------------------
+
+
+def _divisors(n: int) -> List[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def enumerate_space(n_devices: int, *, n_layers: int, n_heads: int,
+                    batch: int, seq: int, max_tp: Optional[int] = None,
+                    max_pp: Optional[int] = None, zero: bool = True,
+                    remat_options: Sequence[bool] = (False, True),
+                    overlap_options: Sequence[int] = (0, 2),
+                    ) -> List[Candidate]:
+    """All candidate plans for ``n_devices``, valid and rejected alike.
+
+    Rejections are kept (status ``rejected`` with the reason) so the
+    emitted report shows WHY a corner of the space is empty — the
+    engine constraints (TP-in-pipeline requires SP, interleaved needs
+    ``M % pp == 0``, ZeRO layouts are global-shape-only so
+    ``zero_shard > 1`` is gated to ``tp == pp == 1``) prune far more
+    than the divisibility arithmetic does.
+    """
+    from apex_tpu.parallel.plan import ParallelPlan
+
+    out: List[Candidate] = []
+    seen = set()
+
+    def reject(reason, **kw):
+        key = ("r", tuple(sorted(kw.items())))
+        if key not in seen:
+            seen.add(key)
+            out.append(Candidate(plan=dict(kw), status="rejected",
+                                 reason=reason))
+
+    def add(**kw):
+        key = ("p", tuple(sorted(kw.items())))
+        if key in seen:
+            return
+        seen.add(key)
+        try:
+            out.append(Candidate(plan=ParallelPlan(**kw)))
+        except ValueError as e:
+            out.append(Candidate(plan=dict(kw), status="rejected",
+                                 reason=str(e)))
+
+    for dp in _divisors(n_devices):
+        for tp in _divisors(n_devices // dp):
+            pp = n_devices // (dp * tp)
+            if max_tp is not None and tp > max_tp:
+                continue
+            if max_pp is not None and pp > max_pp:
+                continue
+            if n_heads % tp:
+                reject(f"num_attention_heads={n_heads} not divisible "
+                       f"by tp={tp}", dp=dp, tp=tp, pp=pp)
+                continue
+            if batch % dp:
+                reject(f"batch={batch} not divisible by dp={dp}",
+                       dp=dp, tp=tp, pp=pp)
+                continue
+            if n_layers % pp:
+                reject(f"num_layers={n_layers} not divisible by pp={pp}",
+                       dp=dp, tp=tp, pp=pp)
+                continue
+            sp_options = [False]
+            if tp > 1:
+                # the ring engine composes TP only with SP (non-SP TP
+                # cotangents are unsound under shard_map); record the
+                # non-SP corner as rejected rather than silently absent
+                reject("pipeline TP requires sequence parallelism "
+                       "(non-SP TP grads are unsound under shard_map)",
+                       dp=dp, tp=tp, pp=pp, sequence_parallel=False)
+                if seq % tp:
+                    reject(f"seq={seq} not divisible by tp={tp} "
+                           "(SP shards the sequence axis)",
+                           dp=dp, tp=tp, pp=pp, sequence_parallel=True)
+                    continue
+                sp_options = [True]
+            m_options = [1, 2] if pp == 1 else [pp, 2 * pp]
+            for sp in sp_options:
+                overlaps = [0] + [c for c in overlap_options
+                                  if c and sp] if sp else [0]
+                for M in m_options:
+                    if (batch // dp) % M:
+                        reject(f"per-dp batch {batch // dp} not "
+                               f"divisible by n_microbatches={M}",
+                               dp=dp, tp=tp, pp=pp, n_microbatches=M)
+                        continue
+                    v_options = [1]
+                    if pp > 1 and n_layers % (pp * 2) == 0 and M % pp == 0:
+                        v_options.append(2)
+                    for v in v_options:
+                        if n_layers % (pp * v):
+                            continue
+                        for remat in remat_options:
+                            for ov in overlaps:
+                                zeros = [1]
+                                if zero and dp > 1 and tp == 1 and pp == 1:
+                                    # ZeRO bucket layouts are computed on
+                                    # global shapes; only a unit tp x pp
+                                    # mesh keeps local == global
+                                    zeros.append(dp)
+                                for z in zeros:
+                                    dtypes = ([None, "bf16"] if z > 1
+                                              else [None])
+                                    for ad in dtypes:
+                                        add(dp=dp, tp=tp, pp=pp,
+                                            sequence_parallel=sp,
+                                            overlap_chunks=ov,
+                                            n_virtual=v,
+                                            n_microbatches=M,
+                                            remat=remat,
+                                            allreduce_dtype=ad,
+                                            zero_shard=z)
+    return out
+
+
+# -- candidate train-step construction ----------------------------------------
+
+
+def build_train_step(plan, cfg_kw: dict, batch: int, seq: int, devices):
+    """The candidate's real program: pipelined grad step + optimizer.
+
+    Returns ``(train_step, args, n_params)``.  ``zero_shard > 1``
+    candidates route the stacked per-device grads through
+    ``DistributedFusedAdam.make_step`` (the reduce-scatter IS the
+    gradient reduction); everything else psum-means over ``data``
+    inside the region and applies ``FusedAdam`` outside it.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.models.gpt import (GPTConfig, GPTModel,
+                                     pack_for_shard_map, pipeline_step)
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.parallel import DistributedFusedAdam
+    from apex_tpu.resilience.elastic import ElasticPlan
+    from apex_tpu.utils.collectives import shard_map_compat
+
+    eplan = ElasticPlan.build(plan, devices=devices)
+    mesh = eplan.mesh
+    serial = GPTModel(GPTConfig(**cfg_kw))
+    params = serial.init_params(jax.random.PRNGKey(0))
+    n_params = sum(int(np.prod(l.shape))
+                   for l in jax.tree_util.tree_leaves(params))
+    par = GPTModel(GPTConfig(plan=plan, **cfg_kw))
+    tensor_axis = "model" if plan.tp > 1 else None
+    packed, in_specs, local_fn, repack_fn = pack_for_shard_map(
+        par, params, n_stages=plan.pp, tensor_axis=tensor_axis,
+        n_virtual=plan.n_virtual)
+    M = plan.n_microbatches
+    mb = batch // (plan.dp * M)
+    if mb < 1:
+        raise ValueError(f"batch={batch} too small for dp={plan.dp} x "
+                         f"M={M}")
+    rng = np.random.RandomState(0)
+    vocab = cfg_kw["vocab_size"]
+    tokens = jnp.asarray(rng.randint(0, vocab, (batch, seq)))
+    targets = jnp.asarray(rng.randint(0, vocab, (batch, seq)))
+    is_spec = lambda x: isinstance(x, P)  # noqa: E731
+
+    if plan.zero_shard > 1:
+        opt = DistributedFusedAdam(lr=1e-3, plan=plan)
+        opt_state = opt.make_init(mesh)(packed)
+        zero_step = opt.make_step(mesh)
+
+        def grad_step(sp_, tk_, tg_):
+            tk = tk_.reshape(M, mb, seq)
+            tg = tg_.reshape(M, mb, seq)
+            # data_axis=None: grads stay per-device — the ZeRO step's
+            # reduce-scatter is the gradient reduction
+            loss, g = pipeline_step(par, local_fn(sp_), tk, tg,
+                                    pipe_axis="pipe", data_axis=None,
+                                    n_virtual=plan.n_virtual)
+            # new unit leading axis -> P("data", ...) out_specs stack
+            # the per-device grads to (world_size, *param.shape), the
+            # layout make_step's reduce-scatter consumes
+            g = jax.tree_util.tree_map(lambda x: x[None], repack_fn(g))
+            return loss[None], g
+
+        g_specs = jax.tree_util.tree_map(lambda s: P("data", *s),
+                                         in_specs, is_leaf=is_spec)
+
+        def train_step(packed_, opt_state_, tk_, tg_):
+            loss, grads = shard_map_compat(
+                grad_step, mesh=mesh,
+                in_specs=(in_specs, P("data"), P("data")),
+                out_specs=(P("data"), g_specs))(packed_, tk_, tg_)
+            new_p, new_s = zero_step(grads, packed_, opt_state_)
+            return loss.mean(), new_p, new_s
+    else:
+        opt = FusedAdam(lr=1e-3)
+        opt_state = opt.init(packed)
+
+        def grad_step(sp_, tk_, tg_):
+            tk = tk_.reshape(M, mb, seq)
+            tg = tg_.reshape(M, mb, seq)
+            loss, g = pipeline_step(par, local_fn(sp_), tk, tg,
+                                    pipe_axis="pipe", data_axis="data",
+                                    n_virtual=plan.n_virtual)
+            return loss, repack_fn(g)
+
+        def train_step(packed_, opt_state_, tk_, tg_):
+            loss, grads = shard_map_compat(
+                grad_step, mesh=mesh,
+                in_specs=(in_specs, P("data"), P("data")),
+                out_specs=(P(), in_specs))(packed_, tk_, tg_)
+            new_p, new_s = opt.step(grads, packed_, opt_state_)
+            return loss, new_p, new_s
+
+    return train_step, (packed, opt_state, tokens, targets), n_params
+
+
+# -- cost prediction ----------------------------------------------------------
+
+
+def calibrate_matmul_flops(n: int = 192) -> float:
+    """Achievable matmul flops/s on one device of THIS host — the
+    roofline's peak.  A measured constant, not a spec-sheet number, so
+    candidate rankings stay meaningful on CPU hosts too."""
+    import jax
+    import jax.numpy as jnp
+
+    from tools._timing import time_steps
+
+    a = jnp.ones((n, n), jnp.float32)
+    f = jax.jit(lambda x, y: x @ y)
+    t = time_steps(f, (a, a), warmup=1, iters=4, rounds=3)
+    return 2.0 * n ** 3 / max(t, 1e-9)
+
+
+def predict_compute_s(plan, n_params: int, batch: int, seq: int,
+                      flops_per_s: float) -> float:
+    """6ND-rule roofline: ``6 * params * tokens`` matmul flops for
+    fwd+bwd (8ND under full remat — the recomputed forward), spread
+    over the plan's devices, divided by pipeline utilization."""
+    from apex_tpu.transformer.pipeline_parallel.ring import bubble_fraction
+
+    flops = 6.0 * float(n_params) * batch * seq
+    if plan.remat:
+        flops *= 8.0 / 6.0
+    t = flops / (plan.n_devices * flops_per_s)
+    if plan.pp > 1:
+        util = 1.0 - bubble_fraction(plan.n_microbatches, plan.pp,
+                                     plan.n_virtual)
+        t /= max(util, 1e-9)
+    return t
+
+
+def predict_comm_s(compiled, cost_model, group_size: int) -> float:
+    """Communication seconds from the candidate's OWN optimized HLO:
+    every collective the compiler actually emitted, priced by the
+    fitted alpha-beta ring model."""
+    from apex_tpu.observability.comms import hlo_collective_stats
+
+    stats = hlo_collective_stats(compiled.as_text())
+    return cost_model.predict_stats(stats, group_size=group_size)["total_s"]
+
+
+def _default_cost_model(n_devices: int):
+    """Probe a minimal in-process profile when no ``--profile`` is
+    given: f32-only, three sizes spanning 4K-1M and EVERY ring width
+    the mesh supports — the fit extrapolates badly outside the probed
+    range (in bytes and in hops alike), and the candidates' gradient
+    reductions sit at the top of both."""
+    from apex_tpu.observability.costmodel import (fit_cost_model,
+                                                  probe_collectives)
+
+    groups = [k for k in (2, 4, 8) if n_devices % k == 0
+              and k <= n_devices]
+    ms = probe_collectives(dtypes=("f32",),
+                           sizes=(1 << 12, 1 << 16, 1 << 20),
+                           group_sizes=groups or None, iters=2, rounds=2)
+    return fit_cost_model(ms, meta={"source": "autotune-inline-probe"})
+
+
+# -- the planner --------------------------------------------------------------
+
+
+def autotune(n_devices: int, *, cfg_kw: Optional[dict] = None,
+             batch: int = 8, seq: Optional[int] = None,
+             hbm_bytes: float = 0.5 * (1 << 30), cost_model=None,
+             top_k: int = 3, max_tp: Optional[int] = None,
+             max_pp: Optional[int] = None, zero: bool = True,
+             remat_options: Sequence[bool] = (False, True),
+             devices=None, measure_iters: int = 2,
+             measure_rounds: int = 2,
+             verbose: bool = True) -> dict:
+    """Full prune -> rank -> measure pass; returns the report dict
+    (the same structure :func:`emit_plan` writes)."""
+    import jax
+
+    from apex_tpu.analysis.memory import estimate_peak_memory
+    from tools._timing import time_steps
+
+    def say(msg):
+        if verbose:
+            print(msg, flush=True)
+
+    cfg_kw = dict(cfg_kw or DEFAULT_MODEL)
+    seq = seq if seq is not None else cfg_kw["max_seq_len"]
+    devices = (list(devices) if devices is not None
+               else jax.devices()[:n_devices])
+    if len(devices) < n_devices:
+        raise RuntimeError(f"need {n_devices} devices, have "
+                           f"{len(devices)}")
+    if cost_model is None:
+        say("no comms profile given; probing a minimal one in-process")
+        cost_model = _default_cost_model(n_devices)
+
+    cands = enumerate_space(
+        n_devices, n_layers=cfg_kw["num_layers"],
+        n_heads=cfg_kw["num_attention_heads"], batch=batch, seq=seq,
+        max_tp=max_tp, max_pp=max_pp, zero=zero,
+        remat_options=remat_options)
+    valid = [c for c in cands if c.status == "enumerated"]
+    say(f"enumerated {len(cands)} points: {len(valid)} valid plans, "
+        f"{len(cands) - len(valid)} rejected")
+    if not valid:
+        raise RuntimeError("search space is empty; every candidate was "
+                           "rejected — see the report's rejection "
+                           "reasons")
+
+    flops_per_s = calibrate_matmul_flops()
+    say(f"calibrated matmul roofline: {flops_per_s / 1e9:.2f} Gflop/s "
+        "per device")
+
+    compiled_by_id = {}
+    for c in valid:
+        plan = c.plan
+        try:
+            step, args, n_params = build_train_step(
+                plan, cfg_kw, batch, seq, devices)
+            compiled = jax.jit(step).lower(*args).compile()
+        except Exception as e:  # noqa: BLE001 — a candidate that cannot
+            # compile is a data point, not a crash
+            c.status, c.reason = "failed", f"{type(e).__name__}: {e}"
+            continue
+        est = estimate_peak_memory(compiled)
+        c.peak_bytes = int(est.peak_bytes)
+        c.xla_peak_bytes = est.xla_peak_bytes
+        c.xla_ratio = est.xla_ratio
+        if est.peak_bytes > hbm_bytes:
+            c.status = "pruned"
+            c.reason = (f"estimated peak {est.peak_bytes} B over the "
+                        f"{int(hbm_bytes)} B per-device budget")
+            continue
+        c.compute_s = predict_compute_s(plan, n_params, batch, seq,
+                                        flops_per_s)
+        c.comm_s = predict_comm_s(compiled, cost_model,
+                                  group_size=max(plan.dp, plan.tp,
+                                                 plan.pp))
+        c.predicted_s = c.compute_s + c.comm_s
+        c.status = "ranked"
+        compiled_by_id[id(c)] = (compiled, args)
+    ranked = sorted((c for c in valid if c.status == "ranked"),
+                    key=lambda c: c.predicted_s)
+    say(f"memory prune: {len(ranked)} survivors of {len(valid)} "
+        f"({sum(1 for c in valid if c.status == 'pruned')} over budget, "
+        f"{sum(1 for c in valid if c.status == 'failed')} failed)")
+    if not ranked:
+        raise RuntimeError("no candidate fits the HBM budget; raise "
+                           "--hbm-gb or shrink the model")
+
+    for c in ranked[:top_k]:
+        compiled, args = compiled_by_id[id(c)]
+        c.measured_s = time_steps(compiled, args, warmup=1,
+                                  iters=measure_iters,
+                                  rounds=measure_rounds)
+        c.status = "measured"
+        say(f"  measured {c.plan.describe():<55} "
+            f"pred={c.predicted_s * 1e3:8.3f} ms  "
+            f"meas={c.measured_s * 1e3:8.3f} ms")
+    measured = sorted((c for c in ranked if c.status == "measured"),
+                      key=lambda c: c.measured_s)
+    winner = measured[0]
+    say(f"winner: {winner.plan.describe()} "
+        f"({winner.measured_s * 1e3:.3f} ms/step measured)")
+
+    return {
+        "version": AUTOTUNE_VERSION,
+        "n_devices": n_devices,
+        "model": cfg_kw,
+        "batch": batch,
+        "seq": seq,
+        "hbm_bytes": int(hbm_bytes),
+        "flops_per_s": flops_per_s,
+        "plan": winner.plan.to_dict(),
+        "predicted_s": winner.predicted_s,
+        "measured_s": winner.measured_s,
+        "candidates": [c.to_dict() for c in cands],
+    }
+
+
+# -- emit / load --------------------------------------------------------------
+
+
+def emit_plan(path: str, report: dict) -> None:
+    with open(path, "w") as f:
+        json.dump(report, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def load_plan(path: str):
+    """The winning :class:`~apex_tpu.parallel.plan.ParallelPlan` from
+    an emitted report — hand it straight to
+    ``HostSignals.request_replan``.  Version-checked at both layers
+    (report envelope here, plan dict in ``ParallelPlan.from_dict``)."""
+    from apex_tpu.parallel.plan import ParallelPlan
+
+    with open(path) as f:
+        report = json.load(f)
+    v = report.get("version")
+    if v != AUTOTUNE_VERSION:
+        raise ValueError(
+            f"autotune report version {v!r} != {AUTOTUNE_VERSION}; "
+            "re-run tools/autotune.py to emit a current report")
+    return ParallelPlan.from_dict(report["plan"])
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--devices", type=int, default=None,
+                    help="mesh size to plan for (default: all visible)")
+    ap.add_argument("--out", default="autotune_plan.json")
+    ap.add_argument("--profile", default=None,
+                    help="comms profile JSON from tools/comms_probe.py "
+                         "(default: probe a minimal one in-process)")
+    ap.add_argument("--hbm-gb", type=float, default=0.5,
+                    help="per-device HBM budget for the memory prune")
+    ap.add_argument("--top-k", type=int, default=3,
+                    help="ranked candidates to measure for real")
+    ap.add_argument("--batch", type=int, default=8,
+                    help="global batch rows for the probe workload")
+    ap.add_argument("--max-tp", type=int, default=None)
+    ap.add_argument("--max-pp", type=int, default=None)
+    ap.add_argument("--no-zero", action="store_true",
+                    help="drop ZeRO (zero_shard > 1) candidates")
+    ap.add_argument("--no-remat", action="store_true",
+                    help="search remat=False only (faster compiles)")
+    ap.add_argument("-q", "--quiet", action="store_true")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    # the axon TPU plugin ignores JAX_PLATFORMS=cpu from the env; flip
+    # the config knob before backend init when the caller asked for cpu
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+
+    n = args.devices or len(jax.devices())
+    cost_model = None
+    if args.profile is not None:
+        from apex_tpu.observability.costmodel import load_profile
+        cost_model, _ = load_profile(args.profile)
+
+    report = autotune(
+        n, hbm_bytes=args.hbm_gb * (1 << 30), cost_model=cost_model,
+        top_k=args.top_k, batch=args.batch, max_tp=args.max_tp,
+        max_pp=args.max_pp, zero=not args.no_zero,
+        remat_options=(False,) if args.no_remat else (False, True),
+        verbose=not args.quiet)
+    emit_plan(args.out, report)
+    if not args.quiet:
+        print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
