@@ -92,7 +92,12 @@ class Response:
     ``error`` carries the exception message) or ``"preempted"`` (the
     engine was preempted and this request could not be requeued —
     :meth:`InferenceEngine.preempt` requeues whenever resume is
-    possible, so this is the exception, not the rule)."""
+    possible, so this is the exception, not the rule).  The fleet
+    router (:class:`apex_tpu.serving.FleetRouter`) additionally emits
+    router-level responses with ``"shed"`` (retry budget exhausted;
+    ``tokens`` carries any progress already streamed) and reuses
+    ``"preempted"`` for a migrated request whose context no longer
+    fits the target replica."""
     request_id: int
     prompt: List[int]
     tokens: List[int]
@@ -157,6 +162,10 @@ class InferenceEngine:
             raise ValueError("max_queue must be >= 1 (or None: unbounded)")
         self.max_queue = max_queue
         self._queue: collections.deque = collections.deque()
+        # backend fault hooks: the serving fleet's fault injector sets
+        # this per tick ("reject_admission" fails submit with QueueFull,
+        # "kv_pool_exhaustion" stalls admission); empty in normal runs
+        self.injected_faults: frozenset = frozenset()
         self._active: dict = {}          # slot -> _Active
         self._submit_time: dict = {}     # request_id -> submit clock value
         self._progress: dict = {}        # request_id -> tokens generated
@@ -233,6 +242,9 @@ class InferenceEngine:
         bounded queue is at capacity (explicit backpressure — nothing is
         silently dropped)."""
         self._validate(request)
+        if "reject_admission" in self.injected_faults:
+            raise QueueFull("injected fault: admission rejected at this "
+                            "replica")
         if self.max_queue is not None and len(self._queue) >= self.max_queue:
             raise QueueFull(
                 f"submit queue is full ({len(self._queue)}/"
@@ -377,7 +389,81 @@ class InferenceEngine:
         self._queue.appendleft(req)
         return 1
 
+    def adopt(self, request: Request, progress: Sequence[int] = ()) -> None:
+        """Admit a request migrated from another replica: ``progress``
+        is the tokens it already streamed there.  Validation and
+        backpressure are :meth:`submit`'s; the progress stash makes the
+        next :meth:`_admit` re-prefill ``prompt + progress`` and resume
+        the ``(seed, token-index)`` sampling stream at
+        ``len(progress)`` — the cross-replica form of the preemption
+        requeue, token-bitwise for the same reason."""
+        if len(request.prompt) + len(progress) >= self.max_seq:
+            raise ValueError(
+                f"context {len(request.prompt)} + {len(progress)} does "
+                f"not fit max_seq={self.max_seq}; finish with "
+                "reason='preempted' instead of adopting")
+        self.submit(request)
+        if progress:
+            self._progress[request.request_id] = list(progress)
+
+    def export_inflight(self) -> List:
+        """Strip every in-flight and queued request off this engine for
+        cross-replica migration; returns ``[(request, generated)]`` in
+        the preemption-requeue order (ascending slot — nearest to done
+        first — then the waiting queue).  ``generated`` is exactly what
+        was already streamed to the client, which is why a replica that
+        dies without warning still leaves its requests recoverable: a
+        healthy replica :meth:`adopt`\\ s each one and the resumed
+        stream is token-bitwise the uninterrupted one.  On THIS engine
+        each request terminates with reason ``"migrated"`` (metrics +
+        trace, no Response — the adopting replica owns the eventual
+        Response)."""
+        out = []
+        for slot in sorted(self._active):
+            st = self._active[slot]
+            out.append((st.request, list(st.generated)))
+        for slot in sorted(self._active, reverse=True):
+            st = self._active.pop(slot)
+            self._release(slot, st)
+        while self._queue:
+            req = self._queue.popleft()
+            out.append((req, list(self._progress.get(req.request_id, []))))
+        for req, _ in out:
+            rid = req.request_id
+            self._submit_time.pop(rid, None)
+            self._progress.pop(rid, None)
+            self.metrics.request_migrated(rid)
+            self.trace.finish(rid, "migrated")
+        return out
+
+    def cancel(self, request_id) -> bool:
+        """Withdraw one request with NO Response (the fleet uses this
+        for the losing copy of a hedged dispatch): frees its slot or
+        queue entry, terminal metrics reason ``"cancelled"``.  Returns
+        False when the id is not on this engine."""
+        for slot, st in list(self._active.items()):
+            if st.request.request_id == request_id:
+                self._release(slot, st)
+                del self._active[slot]
+                break
+        else:
+            hit = None
+            for req in self._queue:
+                if req.request_id == request_id:
+                    hit = req
+                    break
+            if hit is None:
+                return False
+            self._queue.remove(hit)
+        self._submit_time.pop(request_id, None)
+        self._progress.pop(request_id, None)
+        self.metrics.request_cancelled(request_id)
+        self.trace.finish(request_id, "cancelled")
+        return True
+
     def _admit(self) -> None:
+        if "kv_pool_exhaustion" in self.injected_faults:
+            return                      # injected: no capacity to admit
         while self._queue and self.cache.free_slots:
             req = self._queue.popleft()
             slot = self.cache.allocate()

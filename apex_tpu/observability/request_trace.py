@@ -128,6 +128,32 @@ class RequestTracer:
         if self.tracer is not None:
             self.tracer.instant("request_requeued", request_id=request_id)
 
+    # fleet events (FleetRouter): marks on the timeline, same idiom as
+    # requeue — the request's own lifecycle record keeps accumulating
+
+    def retry(self, request_id, attempt: int = 0) -> None:
+        """The fleet re-attempted placement after a failed or shed one."""
+        if self.tracer is not None:
+            self.tracer.instant("request_retry", request_id=request_id,
+                                attempt=attempt)
+
+    def migrate(self, request_id, src: int, dst: int) -> None:
+        """The request moved off a dead replica onto a healthy one."""
+        if self.tracer is not None:
+            self.tracer.instant("request_migrated", request_id=request_id,
+                                src=src, dst=dst)
+
+    def hedge(self, request_id, replica: int) -> None:
+        """A duplicate copy was dispatched for tail-latency cover."""
+        if self.tracer is not None:
+            self.tracer.instant("request_hedged", request_id=request_id,
+                                replica=replica)
+
+    def degrade(self, level: int) -> None:
+        """The fleet's degradation ladder changed level."""
+        if self.tracer is not None:
+            self.tracer.instant("serving_degraded", level=level)
+
     @property
     def pending(self) -> int:
         """Requests enqueued but not yet finished (leak sentinel)."""

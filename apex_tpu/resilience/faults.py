@@ -39,6 +39,28 @@ import numpy as np
 FAULT_KINDS = ("nan_grads", "inf_loss", "grad_spike", "preempt_at_step",
                "corrupt_checkpoint", "slow_host", "topology_change")
 
+# the serving-side fault kinds live in apex_tpu.serving.fleet
+# (SERVING_FAULT_KINDS); its ServingFaultInjector generates schedules
+# from the same seeded_schedule stream below — one discipline for
+# training-step faults and replica-tick faults
+
+
+def seeded_schedule(seed: int, n_steps: int, keys, rates) -> list:
+    """Shared deterministic event stream: for each step and key IN THE
+    GIVEN ORDER, an event fires with probability ``rates[key]`` under
+    one ``RandomState(seed)`` stream — same seed, same schedule, always.
+    Returns ``[(step, key), ...]``.  A rate of 0.0 consumes no stream
+    state, so adding a never-firing kind cannot shift the schedule of
+    the others."""
+    rng = np.random.RandomState(seed)
+    out = []
+    for step in range(n_steps):
+        for key in keys:
+            r = rates.get(key, 0.0)
+            if r > 0.0 and rng.uniform() < r:
+                out.append((step, key))
+    return out
+
 
 class Preemption(RuntimeError):
     """Raised by :meth:`FaultInjector.check_preempt` — the injected
@@ -90,15 +112,12 @@ class FaultInjector:
         bad = set(rates) - set(FAULT_KINDS)
         if bad:
             raise ValueError(f"unknown fault kinds in rates: {sorted(bad)}")
-        rng = np.random.RandomState(seed)
         faults = []
-        for step in range(n_steps):
-            for kind in FAULT_KINDS:       # fixed order => reproducible
-                r = rates.get(kind, 0.0)
-                if r > 0.0 and rng.uniform() < r:
-                    mag = (spike_magnitude if kind == "grad_spike"
-                           else slow_host_s if kind == "slow_host" else 0.0)
-                    faults.append(Fault(step, kind, mag))
+        for step, kind in seeded_schedule(seed, n_steps, FAULT_KINDS,
+                                          rates):
+            mag = (spike_magnitude if kind == "grad_spike"
+                   else slow_host_s if kind == "slow_host" else 0.0)
+            faults.append(Fault(step, kind, mag))
         return cls(faults)
 
     # -- queries -------------------------------------------------------------

@@ -10,15 +10,25 @@ What :mod:`apex_tpu.inference` leaves on the table, this package takes:
   with chunked prefill (:class:`TickScheduler` budgets) and
   exact-match speculative decoding (:class:`SpeculativeConfig`).
 * :class:`Router` — SLO-burn-aware multi-replica admission with
-  explicit shedding (:class:`RequestShed`).
+  explicit shedding (:class:`RequestShed` + :class:`ShedReason` +
+  ``retry_after_s``).
+* :mod:`apex_tpu.serving.fleet` — fault tolerance: deterministic
+  replica fault injection (:class:`ServingFaultInjector`), the
+  health-checked :class:`FleetRouter` (retry/backoff, hedging,
+  cross-replica migration with token-bitwise resume), and the
+  burn-driven :class:`DegradationLadder`.
 
 ``tools/loadgen.py`` drives the stack under heavy-tail open-loop
-traffic and reports TTFT/TPOT/e2e percentiles.
+traffic (and, with ``--scenario``, under chaos workloads) and reports
+TTFT/TPOT/e2e percentiles with per-outcome counts.
 """
 
 from apex_tpu.serving.engine import PagedInferenceEngine
+from apex_tpu.serving.fleet import (SERVING_FAULT_KINDS, DegradationLadder,
+                                    FleetRouter, ReplicaHealth, ServingFault,
+                                    ServingFaultInjector, VirtualClock)
 from apex_tpu.serving.paged_kv import PagedKVCache, PagedSequence
-from apex_tpu.serving.router import RequestShed, Router
+from apex_tpu.serving.router import RequestShed, Router, ShedReason
 from apex_tpu.serving.scheduler import TickPlan, TickScheduler
 from apex_tpu.serving.speculative import SpeculativeConfig
 
@@ -28,7 +38,15 @@ __all__ = [
     "PagedSequence",
     "RequestShed",
     "Router",
+    "ShedReason",
     "TickPlan",
     "TickScheduler",
     "SpeculativeConfig",
+    "SERVING_FAULT_KINDS",
+    "DegradationLadder",
+    "FleetRouter",
+    "ReplicaHealth",
+    "ServingFault",
+    "ServingFaultInjector",
+    "VirtualClock",
 ]

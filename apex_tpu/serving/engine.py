@@ -80,9 +80,18 @@ class PagedInferenceEngine(InferenceEngine):
         self.chunked_prefill = chunked_prefill
         self.scheduler = scheduler or TickScheduler()
         self.spec = speculative
+        # runtime switch over the configured spec path: the fleet's
+        # degradation ladder (level 1) turns speculation off under SLO
+        # burn and back on when burn clears.  Exact-match acceptance
+        # makes the toggle token-invisible; only throughput changes.
+        self.spec_enabled = True
         self.spec_proposed = 0
         self.spec_accepted = 0
         super().__init__(model, params, **kw)
+
+    @property
+    def _spec_active(self) -> bool:
+        return self.spec is not None and self.spec_enabled
 
     # -- backend -------------------------------------------------------------
 
@@ -160,6 +169,8 @@ class PagedInferenceEngine(InferenceEngine):
     # -- admission -----------------------------------------------------------
 
     def _admit(self) -> None:
+        if "kv_pool_exhaustion" in self.injected_faults:
+            return                      # injected: no blocks to admit with
         while self._queue and self._free_slots:
             req = self._queue[0]
             prev = self._progress.get(req.request_id)
@@ -256,14 +267,15 @@ class PagedInferenceEngine(InferenceEngine):
                 len(decoding),
                 [(s, len(self._prefilling[s].ctx) - self._prefilling[s].done)
                  for s in self._prefill_order],
-                self.spec.num_tokens if (self.spec and decoding) else 0)
+                self.spec.num_tokens if (self._spec_active and decoding)
+                else 0)
             for slot, n in plan.chunks.items():
                 if slot in self._prefilling:     # may have been evicted
                     self._run_prefill_chunk(slot, n)
         decoding = sorted(s for s in self._active
                           if s not in self._prefilling)
         if decoding:
-            if self.spec is not None:
+            if self._spec_active:
                 self._spec_round(decoding)
             else:
                 self._decode_round(decoding)

@@ -230,6 +230,27 @@ class PagedKVCache:
             self._c_evict.inc(cache=self.name)
         return True
 
+    def flush_prefixes(self) -> int:
+        """Drop the ENTIRE prefix trie at once (degradation-ladder
+        level 2: shed cached state before shedding requests).  Every
+        trie node's reference is released — blocks still held by live
+        sequences survive until those release; trie-only blocks return
+        to the free list immediately.  Returns the number of trie nodes
+        dropped."""
+        dropped = 0
+        stack = list(self._root.children.values())
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            self._deref(node.block)
+            dropped += 1
+        self._root.children = {}
+        self.evicted_blocks += dropped
+        if self._c_evict is not None and dropped:
+            self._c_evict.inc(dropped, cache=self.name)
+        self._update_gauges()
+        return dropped
+
     # -- sequence lifecycle --------------------------------------------------
 
     def acquire(self, tokens: Sequence[int]) -> Optional[PagedSequence]:

@@ -30,14 +30,39 @@ replica one engine tick (round-robin), :meth:`run` drives to drain.
 
 from __future__ import annotations
 
+import enum
 from typing import List, Optional, Sequence
 
 from apex_tpu.inference.engine import QueueFull, Request, Response
 
 
+class ShedReason(enum.Enum):
+    """Machine-readable reason a request was refused — the enum a
+    client maps to its own backoff/retry policy (and the loadgen's
+    per-reason outcome report keys off)."""
+    OVERLOAD = "overload"                    # every replica over limits
+    NO_HEALTHY_REPLICA = "no_healthy_replica"  # fleet: none HEALTHY
+    CONTEXT_CAP = "context_cap"              # degradation L2 prompt cap
+    DEGRADED = "degraded"                    # degradation L3: shed all
+    RETRY_BUDGET_EXHAUSTED = "retry_budget_exhausted"
+
+
 class RequestShed(RuntimeError):
-    """Every replica was overloaded; the request was refused at the
-    door.  Callers retry with backoff or surface 429/503."""
+    """The request was refused at the door; the caller got an answer in
+    microseconds instead of a timeout in seconds.
+
+    Carries a machine-readable :class:`ShedReason` and a
+    ``retry_after_s`` hint (the serving analogue of HTTP 429's
+    ``Retry-After``) so clients back off *by policy* instead of
+    guessing; ``tools/loadgen.py --client-retries`` honors it with
+    jittered backoff."""
+
+    def __init__(self, msg: str = "request shed", *,
+                 reason: ShedReason = ShedReason.OVERLOAD,
+                 retry_after_s: float = 0.05):
+        super().__init__(msg)
+        self.reason = reason
+        self.retry_after_s = float(retry_after_s)
 
 
 class Router:
@@ -89,20 +114,32 @@ class Router:
             return True
         return burn >= self.burn_threshold and engine.queue_depth >= 1
 
+    def _eligible(self, i: int, engine, burn: float) -> bool:
+        """Placement eligibility hook — subclasses narrow it (the fleet
+        router additionally requires the replica to be HEALTHY)."""
+        return not self._overloaded(engine, burn)
+
+    def _retry_after_hint(self) -> float:
+        """Heuristic Retry-After: half a queue-drain's worth per queued
+        request on the least-loaded replica — deeper backlog, longer
+        hint, so backed-off clients return staggered, not in a thundering
+        herd (the loadgen additionally jitters it)."""
+        depth = min(e.queue_depth for e in self.replicas)
+        return 0.05 * (1.0 + depth / max(self.max_queue_depth, 1))
+
     # -- admission -----------------------------------------------------------
 
-    def submit(self, request: Request) -> int:
-        """Place ``request`` on the best eligible replica; returns the
-        replica index.  Raises :class:`RequestShed` when no replica is
-        eligible (including the race where an eligible replica's own
-        bounded queue filled concurrently — :class:`QueueFull` just
-        moves on to the next candidate)."""
+    def _try_place(self, request: Request) -> Optional[int]:
+        """Place on the best eligible replica; replica index, or None
+        with nowhere to go (the :class:`QueueFull` race — an eligible
+        replica's own bounded queue filling concurrently — just moves
+        on to the next candidate)."""
         scored = []
         for i, eng in enumerate(self.replicas):
             burn = self._burn(eng)
             self._g_depth.set(eng.queue_depth, replica=str(i))
             self._g_burn.set(burn, replica=str(i))
-            if self._overloaded(eng, burn):
+            if not self._eligible(i, eng, burn):
                 continue
             scored.append((eng.queue_depth + eng.active_requests, burn, i))
         for _, _, i in sorted(scored):
@@ -112,12 +149,23 @@ class Router:
                 continue
             self._c_submitted.inc(replica=str(i))
             return i
+        return None
+
+    def submit(self, request: Request) -> int:
+        """Place ``request`` on the best eligible replica; returns the
+        replica index.  Raises :class:`RequestShed` when no replica is
+        eligible."""
+        i = self._try_place(request)
+        if i is not None:
+            return i
         self.shed_requests += 1
         self._c_shed.inc()
         raise RequestShed(
             f"all {len(self.replicas)} replicas overloaded "
             f"(max_queue_depth={self.max_queue_depth}, "
-            f"burn_threshold={self.burn_threshold})")
+            f"burn_threshold={self.burn_threshold})",
+            reason=ShedReason.OVERLOAD,
+            retry_after_s=self._retry_after_hint())
 
     # -- scheduling ----------------------------------------------------------
 

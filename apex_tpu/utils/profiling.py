@@ -220,6 +220,8 @@ class ServingMetrics:
         self.errors = 0                  # poison requests quarantined
         self.timeouts = 0                # per-request timeout expiries
         self.requeued = 0                # preemption requeues (non-terminal)
+        self.migrated = 0                # moved to another replica (fleet)
+        self.cancelled = 0               # hedge losers withdrawn (fleet)
         self._started: float | None = None
         r = self.registry
         self._c_requests = r.counter("serving_requests_total",
@@ -339,6 +341,20 @@ class ServingMetrics:
         self.requeued += 1
         self._c_requeued.inc()
 
+    def request_migrated(self, request_id) -> None:
+        """The fleet moved this request to another replica (this
+        engine's replica was declared dead).  Terminal FOR THIS ENGINE —
+        the adopting replica restarts the request's transient state; the
+        fleet-level count lives in ``serving_migrations_total``."""
+        self.migrated += 1
+        self._terminal(request_id, "migrated")
+
+    def request_cancelled(self, request_id) -> None:
+        """The fleet withdrew this request without a Response (the
+        losing copy of a hedged dispatch)."""
+        self.cancelled += 1
+        self._terminal(request_id, "cancelled")
+
     @property
     def pending_requests(self) -> int:
         """Requests submitted but not yet terminal (leak sentinel:
@@ -364,6 +380,8 @@ class ServingMetrics:
             "errors": self.errors,
             "timeouts": self.timeouts,
             "requeued": self.requeued,
+            "migrated": self.migrated,
+            "cancelled": self.cancelled,
             "tokens_per_s": (self.tokens_emitted / elapsed
                              if elapsed > 0 else 0.0),
             "ttft_p50_s": self._pct(list(self.ttft.values()), 0.5),

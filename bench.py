@@ -1460,6 +1460,86 @@ def bench_serving_paged():
     }
 
 
+def bench_serving_chaos():
+    """Serving-chaos leg (ISSUE 12): recovery time under replica loss.
+
+    Two chaos scenarios from ``tools/loadgen.py`` on a 3-replica CPU
+    fleet over a virtual clock (deterministic, sleep-free):
+
+    * ``replica_kill`` — a replica crashes mid-run; the metric is the
+      detection -> migration -> first-resumed-token chain from the
+      fleet's recovery report, in ticks and virtual seconds.
+    * ``bursty`` — synchronized arrival bursts stress admission,
+      retry/backoff, and the degradation ladder.
+
+    Both scenarios are HARD-GATED on the exactly-once ledger (zero lost,
+    zero client-visible duplicates) and on SLO attainment: losing a
+    replica may cost tail latency, but never correctness."""
+    import argparse
+    import sys
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    try:
+        import loadgen
+    finally:
+        sys.path.pop(0)
+
+    def ns(**kw):
+        base = dict(
+            scenario="replica_kill", requests=16, rate=1e9, replicas=3,
+            max_slots=2, max_queue=64, max_queue_depth=4,
+            burn_threshold=14.4, burn_window_s=60.0, ttft_slo_s=0.5,
+            block_size=4, chunked=False, token_budget=32,
+            client_retries=3, tick_s=0.02, e2e_slo_s=3.0, max_ticks=2000,
+            retry_budget=4, hedge_after_s=None, ladder_step_down_s=0.5,
+            kill_tick=4, kill_replica=1, kill_duration=10 ** 6,
+            slow_tick=4, slow_s=0.1, slow_duration=40, burst_n=6,
+            burst_gap_s=0.3, period_s=2.0, seed=0, min_prompt=4,
+            pareto_shape=2.5, max_new=6, shared_prefix_prob=0.5,
+            shared_prefix_len=8, num_prefixes=2, vocab=64, hidden=32,
+            layers=2, heads=2, max_seq=48)
+        base.update(kw)
+        return argparse.Namespace(**base)
+
+    out = {}
+    for scenario in ("replica_kill", "bursty"):
+        rep = loadgen.run_scenario(ns(scenario=scenario))
+        # correctness gates: exactly-once, nothing stranded
+        assert rep["lost"] == [], (scenario, rep["lost"])
+        assert rep["duplicated"] == 0, scenario
+        assert rep["fleet_pending"] == 0, scenario
+        assert rep["slo_attainment"] >= 0.9, (scenario,
+                                              rep["slo_attainment"])
+        leg = {"responses": rep["responses"],
+               "served": rep["e2e_served"],
+               "slo_attainment": rep["slo_attainment"],
+               "e2e_p50_s": rep["e2e_p50_s"],
+               "e2e_p99_s": rep["e2e_p99_s"],
+               "retries": rep["retries"],
+               "migrations": rep["migrations"],
+               "degraded_max_level": rep["degraded_max_level"],
+               "ticks": rep["ticks"]}
+        if scenario == "replica_kill":
+            rec = rep["recovery"]
+            assert rec["first_dead"] is not None, "kill never detected"
+            assert rec["first_resumed_token"] is not None, \
+                "migrated work never resumed"
+            dead, resumed = rec["first_dead"], rec["first_resumed_token"]
+            kill_t = ns().kill_tick * ns().tick_s
+            leg["recovery"] = {
+                "detect_ticks": dead["tick"] - ns().kill_tick,
+                "detect_s": round(dead["t"] - kill_t, 4),
+                "resume_ticks": resumed["tick"] - ns().kill_tick,
+                "kill_to_first_resumed_token_s": round(
+                    resumed["t"] - kill_t, 4)}
+            assert leg["recovery"]["kill_to_first_resumed_token_s"] \
+                >= 0.0
+        out[scenario] = leg
+    out["exactly_once_ok"] = True
+    return out
+
+
 def bench_lint():
     """Static-analysis leg (ISSUE 8): time the lint gate itself.
 
@@ -1589,6 +1669,7 @@ def main():
     observability = _retry(bench_observability)
     serving_obs = _retry(bench_serving_observability)
     serving_paged = _retry(bench_serving_paged)
+    serving_chaos = _retry(bench_serving_chaos)
     lint_gate = _retry(bench_lint)
     autotune_leg = _retry(bench_autotune)
     rounded = lambda d: (None if d is None else
@@ -1620,6 +1701,7 @@ def main():
             "observability": rounded(observability),
             "serving_observability": rounded(serving_obs),
             "serving_paged": serving_paged,
+            "serving_chaos": serving_chaos,
             "lint": lint_gate,
             "autotune": autotune_leg,
         },

@@ -1,0 +1,463 @@
+"""apex_tpu.serving.fleet: fault injection, health-checked routing,
+cross-replica migration, degradation.
+
+The fleet's correctness contract:
+
+* the serving fault injector is deterministic from its seed and keeps
+  an applied-fault log, like the training injector it mirrors;
+* the health state machine walks healthy → suspect → dead on missed
+  heartbeats and dead → recovering → healthy once beats return;
+* a dead replica's in-flight requests migrate and resume TOKEN-BITWISE
+  (greedy and seeded sampling, contiguous and paged engines) — the
+  ``(seed, token-index)`` stream plus re-prefill of prompt+streamed
+  tokens makes the interruption invisible in the output;
+* a migrated context that no longer fits the target finishes with
+  ``reason="preempted"``; admission retries exhaust their budget into
+  ``reason="shed"``; hedged dispatch completes exactly once;
+* the degradation ladder escalates on burn immediately and de-escalates
+  with hysteresis, and flushing the prefix trie frees its blocks.
+"""
+
+import argparse
+import collections
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.inference import (InferenceEngine, QueueFull, Request,
+                                SamplingParams)
+from apex_tpu.models.gpt import GPTConfig, GPTModel
+from apex_tpu.serving import (DegradationLadder, FleetRouter,
+                              PagedInferenceEngine, PagedKVCache,
+                              ReplicaHealth, RequestShed, Router,
+                              ServingFault, ServingFaultInjector,
+                              ShedReason, VirtualClock)
+from apex_tpu.utils.profiling import ServingMetrics
+
+
+def tiny_cfg(**kw):
+    base = dict(vocab_size=32, hidden_size=16, num_layers=2,
+                num_attention_heads=2, max_seq_len=16)
+    base.update(kw)
+    return GPTConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    model = GPTModel(tiny_cfg())
+    return model, model.init_params(jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def tiny32():
+    model = GPTModel(tiny_cfg(max_seq_len=32))
+    return model, model.init_params(jax.random.PRNGKey(0))
+
+
+def _clone(req: Request) -> Request:
+    return dataclasses.replace(req)
+
+
+def _mixed_requests():
+    return [
+        Request(0, [1, 2, 3, 4, 5], max_new_tokens=6),
+        Request(1, [1, 2, 3, 9], max_new_tokens=5, seed=7,
+                sampling=SamplingParams(temperature=0.8, top_k=5)),
+        Request(2, [1, 2, 3, 4, 5, 6, 7], max_new_tokens=4, seed=3,
+                sampling=SamplingParams(temperature=1.1, top_p=0.9)),
+        Request(3, [4, 4, 4], max_new_tokens=5, seed=11,
+                sampling=SamplingParams(temperature=1.0, top_k=8,
+                                        top_p=0.8)),
+    ]
+
+
+def _engine(model, params, paged, clock, **kw):
+    cls = PagedInferenceEngine if paged else InferenceEngine
+    if paged:
+        kw.setdefault("block_size", 4)
+    return cls(model, params, max_slots=2,
+               metrics=ServingMetrics(clock), clock=clock, **kw)
+
+
+def _fleet(model, params, *, n=2, paged=False, injector=None, **kw):
+    clock = VirtualClock()
+    replicas = [_engine(model, params, paged, clock) for _ in range(n)]
+    kw.setdefault("suspect_after", 1)
+    kw.setdefault("dead_after", 2)
+    kw.setdefault("recover_after", 2)
+    fleet = FleetRouter(replicas, injector=injector, clock=clock, **kw)
+    return fleet, replicas, clock
+
+
+# -- fault injector ----------------------------------------------------------
+
+class TestServingFaultInjector:
+    def test_seed_determinism(self):
+        rates = {"replica_crash": 0.05, "slow_replica": 0.1,
+                 "reject_admission": 0.2}
+        a = ServingFaultInjector.from_seed(3, 40, 2, rates)
+        b = ServingFaultInjector.from_seed(3, 40, 2, rates)
+        assert a.schedule == b.schedule and a.schedule
+        c = ServingFaultInjector.from_seed(4, 40, 2, rates)
+        assert a.schedule != c.schedule
+
+    def test_duration_window_and_log(self):
+        f = ServingFault(3, 0, "stuck_decode", duration=2)
+        inj = ServingFaultInjector([f])
+        assert inj.faults_at(2, 0) == ()
+        assert inj.faults_at(3, 0) == (f,) and inj.faults_at(4, 0) == (f,)
+        assert inj.faults_at(5, 0) == ()
+        assert inj.faults_at(3, 1) == ()        # other replica unaffected
+        # activate records ONCE, at first application
+        inj.activate(3, 0)
+        inj.activate(4, 0)
+        assert inj.log == [(3, 0, "stuck_decode")]
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            ServingFault(0, 0, "grad_spike")     # training kind
+        with pytest.raises(ValueError):
+            ServingFault(0, 0, "replica_crash", duration=0)
+        with pytest.raises(ValueError):
+            ServingFaultInjector.from_seed(0, 10, 2,
+                                           {"nan_grads": 0.5})
+
+
+# -- engine migration primitives ---------------------------------------------
+
+class TestEnginePrimitives:
+    @pytest.mark.parametrize("paged", [False, True])
+    def test_export_then_adopt_resumes_bitwise(self, tiny, paged):
+        model, params = tiny
+        clock = VirtualClock()
+        ref = _engine(model, params, paged, clock)
+        reqs = _mixed_requests()
+        for r in reqs:
+            ref.submit(_clone(r))
+        want = {r.request_id: (r.tokens, r.finish_reason)
+                for r in ref.run()}
+
+        src = _engine(model, params, paged, clock)
+        dst = _engine(model, params, paged, clock)
+        for r in reqs:
+            src.submit(_clone(r))
+        for _ in range(3):                      # mid-decode on src
+            src.step()
+        moved = src.export_inflight()
+        assert moved and not src._active and not src._queue
+        assert src.metrics.migrated == len(moved)
+        # src emitted Responses only for requests that FINISHED there
+        finished_on_src = {r.request_id: (r.tokens, r.finish_reason)
+                           for r in src.completed}
+        for req, progress in moved:
+            assert req.request_id not in finished_on_src
+            dst.adopt(req, progress)
+        got = dict(finished_on_src)
+        got.update({r.request_id: (r.tokens, r.finish_reason)
+                    for r in dst.run()})
+        assert got == want                      # token-bitwise continuation
+
+    def test_adopt_rejects_overflow(self, tiny):
+        model, params = tiny
+        eng = _engine(model, params, False, VirtualClock())
+        with pytest.raises(ValueError):
+            eng.adopt(Request(0, [1] * 10), progress=[2] * 6)
+
+    def test_cancel_active_and_queued(self, tiny):
+        model, params = tiny
+        eng = _engine(model, params, False, VirtualClock())
+        for i in range(3):                      # 2 slots -> 1 queued
+            eng.submit(Request(i, [1, 2, 3], max_new_tokens=4))
+        eng.step()
+        assert eng.cancel(0) and eng.cancel(2)  # one active, one queued
+        assert not eng.cancel(99)
+        assert eng.metrics.cancelled == 2
+        out = {r.request_id for r in eng.run()}
+        assert out == {1}                       # no Response for cancelled
+
+    def test_injected_admission_faults(self, tiny):
+        model, params = tiny
+        eng = _engine(model, params, False, VirtualClock())
+        eng.injected_faults = frozenset({"reject_admission"})
+        with pytest.raises(QueueFull):
+            eng.submit(Request(0, [1, 2, 3]))
+        eng.injected_faults = frozenset({"kv_pool_exhaustion"})
+        eng.submit(Request(1, [1, 2, 3], max_new_tokens=2))
+        eng.step()
+        assert eng.queue_depth == 1             # admission stalled
+        eng.injected_faults = frozenset()
+        eng.step()
+        assert eng.queue_depth == 0
+
+
+# -- health state machine ----------------------------------------------------
+
+class TestHealth:
+    def test_crash_walks_suspect_dead_recovering_healthy(self, tiny):
+        model, params = tiny
+        inj = ServingFaultInjector([
+            ServingFault(1, 1, "replica_crash", duration=3)])
+        fleet, _, _ = _fleet(model, params, injector=inj)
+        for _ in range(8):
+            fleet.step()
+        assert [(r, a, b) for _, r, a, b in fleet.health_log] == [
+            (1, "healthy", "suspect"), (1, "suspect", "dead"),
+            (1, "dead", "recovering"), (1, "recovering", "healthy")]
+        assert fleet.health(1) is ReplicaHealth.HEALTHY
+        assert inj.log == [(1, 1, "replica_crash")]
+
+    def test_placement_excludes_non_healthy(self, tiny):
+        model, params = tiny
+        inj = ServingFaultInjector([
+            ServingFault(1, 0, "replica_crash", duration=100)])
+        fleet, replicas, _ = _fleet(model, params)
+        fleet.injector = inj
+        fleet.step()                            # replica 0 -> suspect
+        assert fleet.health(0) is ReplicaHealth.SUSPECT
+        for i in range(4):
+            assert fleet.submit(Request(i, [1, 2, 3],
+                                        max_new_tokens=2)) == 1
+        assert replicas[0].queue_depth + replicas[0].active_requests == 0
+
+    def test_slow_replica_goes_suspect_not_dead(self, tiny):
+        model, params = tiny
+        inj = ServingFaultInjector([
+            ServingFault(1, 1, "slow_replica", magnitude=0.5,
+                         duration=6)])
+        fleet, _, _ = _fleet(model, params, n=3, injector=inj,
+                             slow_after=2)
+        # keep the fleet busy so ticks measure real work
+        for i in range(6):
+            fleet.submit(Request(i, [1, 2, 3], max_new_tokens=8))
+        for _ in range(6):
+            fleet.step()
+        assert fleet.health(1) is ReplicaHealth.SUSPECT
+        assert ("dead" not in
+                {b for _, r, _, b in fleet.health_log if r == 1})
+        for _ in range(8):                      # fault over: normalizes
+            fleet.step()
+        assert fleet.health(1) is ReplicaHealth.HEALTHY
+
+
+# -- cross-replica migration -------------------------------------------------
+
+class TestMigration:
+    @pytest.mark.parametrize("paged", [False, True])
+    def test_replica_kill_token_parity(self, tiny, paged):
+        """Kill a replica mid-decode: every request — greedy and seeded,
+        migrated or not — matches the uninterrupted single-engine run."""
+        model, params = tiny
+        reqs = _mixed_requests()
+        ref = _engine(model, params, paged, VirtualClock())
+        for r in reqs:
+            ref.submit(_clone(r))
+        want = {r.request_id: (r.tokens, r.finish_reason)
+                for r in ref.run()}
+
+        inj = ServingFaultInjector([
+            ServingFault(3, 0, "replica_crash", duration=10 ** 6)])
+        fleet, _, _ = _fleet(model, params, paged=paged, injector=inj)
+        for r in reqs:
+            fleet.submit(_clone(r))
+        out = {r.request_id: (r.tokens, r.finish_reason)
+               for r in fleet.run(max_steps=200)}
+        assert fleet.migrations > 0             # the kill hit live work
+        assert out == want
+        assert fleet.pending == 0
+        assert fleet.duplicate_responses == 0
+
+    def test_migration_overflow_finishes_preempted(self, tiny, tiny32):
+        """Heterogeneous fleet: the dead replica's context no longer
+        fits the survivor's max_seq -> reason='preempted' with the
+        already-streamed tokens, not a hang and not a loss."""
+        model16, params16 = tiny
+        model32, params32 = tiny32
+        clock = VirtualClock()
+        big = _engine(model32, params32, False, clock)    # max_seq 32
+        small = _engine(model16, params16, False, clock)  # max_seq 16
+        inj = ServingFaultInjector([
+            ServingFault(3, 0, "replica_crash", duration=10 ** 6)])
+        fleet = FleetRouter([big, small], injector=inj, clock=clock,
+                            suspect_after=1, dead_after=2)
+        req = Request(0, [1] * 20, max_new_tokens=8)
+        assert fleet.submit(req) == 0           # only fits the big one
+        out = {r.request_id: r for r in fleet.run(max_steps=50)}
+        assert out[0].finish_reason == "preempted"
+        assert len(out[0].tokens) >= 1          # progress preserved
+        assert fleet.pending == 0
+
+    def test_retry_budget_exhaustion_sheds(self, tiny):
+        model, params = tiny
+        inj = ServingFaultInjector([
+            ServingFault(1, 0, "replica_crash", duration=10 ** 6)])
+        fleet, _, clock = _fleet(model, params, n=1, injector=inj,
+                                 retry_budget=2, retry_base_s=0.01)
+        fleet.step()
+        fleet.step()                            # replica 0 now DEAD
+        assert fleet.health(0) is ReplicaHealth.DEAD
+        assert fleet.submit(Request(0, [1, 2, 3])) == -1   # parked
+        for _ in range(30):
+            fleet.step()
+            clock.advance(0.05)
+        out = {r.request_id: r for r in fleet.completed}
+        assert out[0].finish_reason == "shed"
+        assert fleet.retries > 0 and fleet.pending == 0
+
+    def test_submit_raises_no_healthy_when_budget_zero(self, tiny):
+        model, params = tiny
+        inj = ServingFaultInjector([
+            ServingFault(1, 0, "replica_crash", duration=10 ** 6)])
+        fleet, _, _ = _fleet(model, params, n=1, injector=inj,
+                             retry_budget=0)
+        fleet.step()
+        fleet.step()
+        with pytest.raises(RequestShed) as ei:
+            fleet.submit(Request(0, [1, 2, 3]))
+        assert ei.value.reason is ShedReason.NO_HEALTHY_REPLICA
+        assert ei.value.retry_after_s > 0
+
+
+# -- hedging -----------------------------------------------------------------
+
+class TestHedging:
+    def test_stuck_replica_hedge_completes_exactly_once(self, tiny):
+        model, params = tiny
+        ref = _engine(model, params, False, VirtualClock())
+        req = Request(0, [1, 2, 3, 4], max_new_tokens=5)
+        ref.submit(_clone(req))
+        want = ref.run()[0]
+
+        inj = ServingFaultInjector([
+            ServingFault(1, 0, "stuck_decode", duration=10 ** 6)])
+        fleet, _, clock = _fleet(model, params, injector=inj,
+                                 suspect_after=2, dead_after=6,
+                                 hedge_after_s=0.1)
+        fleet.submit(_clone(req))               # lands on replica 0
+        for _ in range(30):
+            fleet.step()
+            clock.advance(0.05)
+        out = [r for r in fleet.completed]
+        assert len(out) == 1                    # exactly once
+        assert fleet.hedges == 1
+        assert (out[0].tokens, out[0].finish_reason) == \
+            (want.tokens, want.finish_reason)
+
+
+# -- degradation -------------------------------------------------------------
+
+class TestDegradation:
+    def test_ladder_escalates_immediately_steps_down_slowly(self):
+        lad = DegradationLadder(thresholds=(2.0, 6.0, 14.4),
+                                step_down_s=1.0)
+        assert lad.update(1.0, 0.0) == 0
+        assert lad.update(7.0, 0.1) == 2        # straight to L2
+        assert lad.update(20.0, 0.2) == 3
+        assert lad.update(0.0, 0.3) == 3        # hysteresis holds
+        assert lad.update(0.0, 1.4) == 2        # one level per window
+        assert lad.update(0.0, 2.5) == 1
+        assert lad.update(7.0, 2.6) == 2        # re-escalates instantly
+
+    def test_ladder_validation(self):
+        with pytest.raises(ValueError):
+            DegradationLadder(thresholds=(6.0, 2.0, 14.4))
+        with pytest.raises(ValueError):
+            DegradationLadder(ctx_cap_frac=0.0)
+
+    def test_flush_prefixes_frees_trie_blocks(self):
+        pool = PagedKVCache(9, 4, layers=2, kv_heads=2, head_dim=4,
+                            dtype=jnp.float32)
+        seq = pool.acquire([1] * 8)             # 2 blocks
+        pool.register_prefix(seq, [1] * 8)
+        pool.release(seq)
+        assert pool.free_blocks == 6            # trie still holds 2
+        assert pool.flush_prefixes() == 2
+        assert pool.free_blocks == 8
+        assert pool.flush_prefixes() == 0       # idempotent
+
+    def test_fleet_degrade_sheds_with_reason(self, tiny):
+        model, params = tiny
+        fleet, _, _ = _fleet(model, params)
+        fleet.ladder = DegradationLadder()
+        fleet.ladder.level = 3
+        with pytest.raises(RequestShed) as ei:
+            fleet.submit(Request(0, [1, 2, 3]))
+        assert ei.value.reason is ShedReason.DEGRADED
+        fleet.ladder.level = 2
+        with pytest.raises(RequestShed) as ei:
+            fleet.submit(Request(1, [1] * 12))  # over the 50% ctx cap
+        assert ei.value.reason is ShedReason.CONTEXT_CAP
+        assert fleet.submit(Request(2, [1, 2, 3])) >= 0   # short: admitted
+
+
+# -- shed metadata on the plain router ---------------------------------------
+
+class TestShedMetadata:
+    def test_overload_shed_carries_reason_and_hint(self, tiny):
+        model, params = tiny
+        clock = VirtualClock()
+        replicas = [_engine(model, params, False, clock)
+                    for _ in range(2)]
+        router = Router(replicas, max_queue_depth=1)
+        for i in range(8):
+            try:
+                router.submit(Request(i, [1, 2, 3]))
+            except RequestShed as e:
+                assert e.reason is ShedReason.OVERLOAD
+                assert e.retry_after_s > 0
+                break
+        else:
+            pytest.fail("router never shed")
+
+
+# -- chaos scenario smoke (the loadgen suite) --------------------------------
+
+def _scenario_ns(**kw):
+    base = dict(
+        scenario="replica_kill", requests=8, rate=1e9, replicas=3,
+        max_slots=2, max_queue=64, max_queue_depth=4,
+        burn_threshold=14.4, burn_window_s=60.0, ttft_slo_s=0.5,
+        block_size=4, chunked=False, token_budget=32, client_retries=3,
+        tick_s=0.02, e2e_slo_s=3.0, max_ticks=600, retry_budget=4,
+        hedge_after_s=None, ladder_step_down_s=0.5, kill_tick=3,
+        kill_replica=1, kill_duration=10 ** 6, slow_tick=4, slow_s=0.1,
+        slow_duration=40, burst_n=4, burst_gap_s=0.3, period_s=2.0,
+        seed=0, min_prompt=4, pareto_shape=2.5, max_new=4,
+        shared_prefix_prob=0.5, shared_prefix_len=8, num_prefixes=2,
+        vocab=32, hidden=16, layers=2, heads=2, max_seq=32)
+    base.update(kw)
+    return argparse.Namespace(**base)
+
+
+class TestScenarios:
+    def _loadgen(self):
+        import importlib
+        import os
+        import sys
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools"))
+        try:
+            return importlib.import_module("loadgen")
+        finally:
+            sys.path.pop(0)
+
+    def test_replica_kill_exactly_once(self):
+        rep = self._loadgen().run_scenario(_scenario_ns())
+        assert rep["submitted"] == 8
+        assert rep["responses"] == 8
+        assert rep["lost"] == [] and rep["duplicated"] == 0
+        assert rep["migrations"] > 0
+        assert rep["fleet_pending"] == 0
+        # the dead replica's transitions are on the health log
+        assert ("suspect", "dead") in {(a, b) for _, r, a, b in
+                                       rep["health_log"] if r == 1}
+        assert rep["recovery"]["first_dead"] is not None
+        assert rep["recovery"]["first_resumed_token"] is not None
+
+    def test_scenario_determinism(self):
+        a = self._loadgen().run_scenario(_scenario_ns())
+        b = self._loadgen().run_scenario(_scenario_ns())
+        assert a == b                           # virtual clock: bitwise

@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Open-loop load generator for the apex_tpu serving stack.
+"""Open-loop load generator + chaos scenario suite for apex_tpu serving.
 
 Synthesizes realistic serving traffic against a multi-replica
 :class:`~apex_tpu.serving.Router` of paged engines and reports the
@@ -16,28 +16,59 @@ numbers an operator actually tunes against:
   variants), exercising the radix-trie block reuse;
 * **SLO pressure**: every replica gets a TTFT SLOTarget; the router's
   burn-rate admission and queue-depth shedding run live, and the
-  report separates served from shed traffic.
+  report separates served from shed traffic;
+* **client backoff**: a shed request is NOT silently dropped — with
+  ``--client-retries`` > 0 the client honors the shed's machine-readable
+  ``retry_after_s`` with jitter and resubmits, the way a real client
+  maps a 429.  The report counts every outcome (eos/length/timeout/
+  evicted/shed/...) separately instead of silently excluding failures
+  from the percentiles.
 
 Reported: TTFT p50/p90/p99 (engine-measured, submit → first token),
 TPOT (per-token decode latency after the first), end-to-end latency
 percentiles (host-tracked, submit → completion), throughput
-(tokens/s over the drive wall time), shed fraction, and the pool's
-prefix-cache hit rate.
+(tokens/s over the drive wall time), shed fraction, per-outcome
+counts, and the pool's prefix-cache hit rate.
 
 ``--overload`` submits the whole workload as an instantaneous burst
 (rate → ∞), deterministically driving queue depths past the admission
 bound so the shedding path is exercised regardless of host speed — the
 mode the dryrun gate runs.
 
+**Chaos scenarios** (``--scenario``): the fleet-level suite.  The stack
+becomes a :class:`~apex_tpu.serving.FleetRouter` (health checks, retry/
+hedging, cross-replica migration, degradation ladder) on a
+:class:`~apex_tpu.serving.VirtualClock`, so fault timing, backoff and
+SLO burn are deterministic on any host:
+
+* ``steady`` — the baseline: no faults, same fleet machinery;
+* ``replica_kill`` — a replica crashes mid-burst (``--kill-tick``);
+  its in-flight requests migrate and resume token-bitwise;
+* ``slow_replica`` — one replica silently degrades
+  (``--slow-s`` extra seconds/tick); the straggler detector marks it
+  SUSPECT and hedged dispatch covers the tail;
+* ``diurnal`` — a sin²-modulated arrival rate (the traffic shape
+  ROADMAP item 4's capacity shifting trains against);
+* ``bursty`` — synchronized arrival bursts driving overload, the
+  degradation ladder, shedding with retry_after, and client backoff.
+
+Every scenario report carries the exactly-once ledger (``submitted`` /
+``lost`` / ``duplicated``), per-outcome counts, SLO attainment over the
+virtual clock, the fleet's health/fault logs, and the
+detection→migration→first-resumed-token recovery timeline.
+
 Usage::
 
     python tools/loadgen.py --requests 64 --rate 32 --replicas 2
     python tools/loadgen.py --overload --json
+    python tools/loadgen.py --scenario replica_kill --replicas 3 --json
+    python tools/loadgen.py --scenario bursty --client-retries 5
 """
 
 from __future__ import annotations
 
 import argparse
+import bisect
 import json
 import os
 import sys
@@ -49,17 +80,15 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 import jax            # noqa: E402
 import numpy as np    # noqa: E402
 
+SCENARIOS = ("steady", "replica_kill", "slow_replica", "diurnal", "bursty")
+
 
 def _pct(xs, q):
     return float(np.percentile(np.asarray(xs, np.float64), q)) if xs else 0.0
 
 
-def build_stack(args):
-    """(router, replicas): paged engines behind an SLO-aware router."""
+def _build_model(args):
     from apex_tpu.models.gpt import GPTConfig, GPTModel
-    from apex_tpu.observability.slo import SLOMonitor, SLOTarget
-    from apex_tpu.serving import PagedInferenceEngine, Router, TickScheduler
-    from apex_tpu.utils.profiling import ServingMetrics
 
     cfg = GPTConfig(vocab_size=args.vocab, hidden_size=args.hidden,
                     num_layers=args.layers,
@@ -67,17 +96,34 @@ def build_stack(args):
                     max_seq_len=args.max_seq)
     model = GPTModel(cfg)
     params = model.init_params(jax.random.PRNGKey(0))
+    return model, params
+
+
+def _build_replicas(args, model, params, clock):
+    from apex_tpu.observability.slo import SLOMonitor, SLOTarget
+    from apex_tpu.serving import PagedInferenceEngine, TickScheduler
+    from apex_tpu.utils.profiling import ServingMetrics
+
     replicas = []
     for _ in range(args.replicas):
         slo = SLOMonitor([SLOTarget("ttft", args.ttft_slo_s,
-                                    objective=0.9)])
-        metrics = ServingMetrics(time.monotonic, slo=slo)
+                                    objective=0.9)], clock=clock)
+        metrics = ServingMetrics(clock, slo=slo)
         replicas.append(PagedInferenceEngine(
             model, params, max_slots=args.max_slots,
             block_size=args.block_size,
             chunked_prefill=args.chunked,
             scheduler=TickScheduler(token_budget=args.token_budget),
-            metrics=metrics, max_queue=args.max_queue))
+            metrics=metrics, max_queue=args.max_queue, clock=clock))
+    return replicas
+
+
+def build_stack(args):
+    """(router, replicas): paged engines behind an SLO-aware router."""
+    from apex_tpu.serving import Router
+
+    model, params = _build_model(args)
+    replicas = _build_replicas(args, model, params, time.monotonic)
     router = Router(replicas, max_queue_depth=args.max_queue_depth,
                     burn_threshold=args.burn_threshold,
                     burn_window_s=args.burn_window_s)
@@ -108,25 +154,50 @@ def synthesize(args):
     return work
 
 
+def _outcome_counts(responses, shed_client: int) -> dict:
+    out: dict = {}
+    for rep in responses.values():
+        out[rep.finish_reason] = out.get(rep.finish_reason, 0) + 1
+    if shed_client:
+        out["shed_client"] = shed_client
+    return out
+
+
 def run_loadgen(args) -> dict:
     from apex_tpu.serving import RequestShed
 
     router, replicas = build_stack(args)
     work = synthesize(args)
+    client_retries = int(getattr(args, "client_retries", 0))
+    crng = np.random.RandomState(getattr(args, "seed", 0) + 1)
     placed: dict = {}                    # request_id -> replica index
     submit_t: dict = {}
     shed = 0
+    retried = 0
     t0 = time.monotonic()
-    pending = list(work)
+    # (arrival, tiebreak, request, retries_left) — the tiebreak keeps
+    # bisect away from comparing Request objects
+    pending = [(t, i, req, client_retries)
+               for i, (t, req) in enumerate(work)]
+    seq = len(pending)
     while pending or any(e._queue or e._active for e in replicas):
         now = time.monotonic() - t0
         while pending and pending[0][0] <= now:
-            _, req = pending.pop(0)
-            submit_t[req.request_id] = time.monotonic()
+            _, _, req, retries = pending.pop(0)
+            submit_t.setdefault(req.request_id, time.monotonic())
             try:
                 placed[req.request_id] = router.submit(req)
-            except RequestShed:
-                shed += 1
+            except RequestShed as e:
+                if retries > 0:
+                    # honor the hint, jittered so backed-off clients
+                    # return staggered instead of as a second burst
+                    back = e.retry_after_s * (1.0 + 0.5 * crng.rand())
+                    bisect.insort(pending,
+                                  (now + back, seq, req, retries - 1))
+                    seq += 1
+                    retried += 1
+                else:
+                    shed += 1
         router.step()
     wall = time.monotonic() - t0
 
@@ -153,6 +224,8 @@ def run_loadgen(args) -> dict:
         "served": len(responses),
         "shed": shed,
         "shed_fraction": shed / args.requests if args.requests else 0.0,
+        "client_retries": retried,
+        "outcomes": _outcome_counts(responses, shed),
         "wall_s": wall,
         "tokens": tokens,
         "throughput_tok_s": tokens / wall if wall else 0.0,
@@ -169,6 +242,182 @@ def run_loadgen(args) -> dict:
                      for i, e in enumerate(replicas)],
     }
     return report
+
+
+# -- chaos scenarios ---------------------------------------------------------
+
+
+def _scenario_injector(args):
+    from apex_tpu.serving import ServingFault, ServingFaultInjector
+
+    s = args.scenario
+    if s == "replica_kill":
+        return ServingFaultInjector([ServingFault(
+            args.kill_tick, args.kill_replica % args.replicas,
+            "replica_crash", duration=args.kill_duration)])
+    if s == "slow_replica":
+        return ServingFaultInjector([ServingFault(
+            args.slow_tick, 1 % args.replicas, "slow_replica",
+            magnitude=args.slow_s, duration=args.slow_duration)])
+    return None     # steady / diurnal / bursty shape the LOAD, not faults
+
+
+def synthesize_scenario(args):
+    """Virtual-time arrivals per scenario + the usual heavy-tail
+    prompts; reproducible from ``--seed`` alone."""
+    from apex_tpu.inference import Request
+
+    rng = np.random.RandomState(args.seed)
+    prefixes = [list(rng.randint(1, args.vocab,
+                                 args.shared_prefix_len).astype(int))
+                for _ in range(args.num_prefixes)]
+    n = args.requests
+    times = []
+    if args.scenario == "bursty":
+        t = 0.0
+        while len(times) < n:
+            times.extend([t] * min(args.burst_n, n - len(times)))
+            t += args.burst_gap_s
+    elif args.scenario == "diurnal":
+        # thinning: candidate arrivals at the peak rate, accepted with
+        # probability rate(t)/peak where rate(t) ~ sin^2 over --period-s
+        t = 0.0
+        while len(times) < n:
+            t += float(rng.exponential(1.0 / args.rate))
+            frac = 0.1 + 0.9 * float(
+                np.sin(np.pi * t / args.period_s) ** 2)
+            if rng.rand() < frac:
+                times.append(t)
+    else:
+        t = 0.0
+        for _ in range(n):
+            t += float(rng.exponential(1.0 / args.rate))
+            times.append(t)
+    work = []
+    for i, t in enumerate(times):
+        tail = min(int(rng.pareto(args.pareto_shape) * args.min_prompt)
+                   + args.min_prompt, args.max_seq - args.max_new - 1)
+        toks = list(rng.randint(1, args.vocab, tail).astype(int))
+        if rng.rand() < args.shared_prefix_prob:
+            toks = (prefixes[rng.randint(args.num_prefixes)]
+                    + toks)[:args.max_seq - args.max_new - 1]
+        work.append((t, Request(i, toks, max_new_tokens=args.max_new,
+                                seed=i)))
+    return work
+
+
+def build_fleet(args, clock):
+    """(fleet, replicas, injector): the fault-tolerant stack on an
+    injectable clock."""
+    from apex_tpu.serving import DegradationLadder, FleetRouter
+
+    model, params = _build_model(args)
+    replicas = _build_replicas(args, model, params, clock)
+    injector = _scenario_injector(args)
+    ladder = DegradationLadder(
+        thresholds=(args.burn_threshold / 7.2, args.burn_threshold / 2.4,
+                    args.burn_threshold),
+        step_down_s=args.ladder_step_down_s)
+    fleet = FleetRouter(
+        replicas, injector=injector, clock=clock,
+        max_queue_depth=args.max_queue_depth,
+        burn_threshold=args.burn_threshold,
+        burn_window_s=args.burn_window_s,
+        retry_budget=args.retry_budget,
+        hedge_after_s=args.hedge_after_s,
+        ladder=ladder, seed=args.seed)
+    return fleet, replicas, injector
+
+
+def run_scenario(args) -> dict:
+    """Drive one chaos scenario on the virtual clock; returns the
+    asserting-ready report (exactly-once ledger, SLO attainment,
+    health/fault logs, recovery timeline)."""
+    from apex_tpu.serving import RequestShed, VirtualClock
+
+    clock = VirtualClock()
+    fleet, replicas, injector = build_fleet(args, clock)
+    work = synthesize_scenario(args)
+    crng = np.random.RandomState(args.seed + 1)
+    pending = [(t, i, req, int(args.client_retries))
+               for i, (t, req) in enumerate(work)]
+    seq = len(pending)
+    submit_t: dict = {}
+    finish_t: dict = {}
+    submitted: set = set()
+    shed_client: dict = {}               # request_id -> final shed reason
+    ticks = 0
+    seen = 0
+    degraded_max = 0
+    while True:
+        now = clock()
+        while pending and pending[0][0] <= now:
+            _, _, req, retries = pending.pop(0)
+            try:
+                fleet.submit(req)
+                submitted.add(req.request_id)
+                submit_t.setdefault(req.request_id, now)
+                shed_client.pop(req.request_id, None)
+            except RequestShed as e:
+                if retries > 0:
+                    back = e.retry_after_s * (1.0 + 0.5 * crng.rand())
+                    bisect.insort(pending,
+                                  (now + back, seq, req, retries - 1))
+                    seq += 1
+                else:
+                    shed_client[req.request_id] = e.reason.value
+        busy = fleet.step()
+        clock.advance(args.tick_s)
+        ticks += 1
+        if fleet.ladder is not None:
+            degraded_max = max(degraded_max, fleet.ladder.level)
+        done = fleet.completed
+        while seen < len(done):
+            finish_t[done[seen].request_id] = clock()
+            seen += 1
+        if not pending and not busy \
+                and not any(e._queue or e._active for e in replicas):
+            break
+        if ticks >= args.max_ticks:
+            break
+    responses = {r.request_id: r for r in fleet.completed}
+    dup_client = sum(1 for _ in fleet.completed) - len(responses)
+    lost = sorted(submitted - set(responses))
+    e2e_ok = [finish_t[rid] - submit_t[rid] for rid, rep in
+              responses.items()
+              if rep.finish_reason in ("eos", "length")
+              and rid in finish_t and rid in submit_t]
+    attainment = (sum(1 for v in e2e_ok if v <= args.e2e_slo_s)
+                  / len(e2e_ok)) if e2e_ok else 0.0
+    ttfts = [t for e in replicas for t in e.metrics.ttft.values()]
+    tokens = sum(len(r.tokens) for r in responses.values())
+    return {
+        "scenario": args.scenario,
+        "requests": args.requests,
+        "submitted": len(submitted),
+        "responses": len(responses),
+        "lost": lost,
+        "duplicated": dup_client,
+        "engine_duplicates_suppressed": fleet.duplicate_responses,
+        "shed_client": len(shed_client),
+        "outcomes": _outcome_counts(responses, len(shed_client)),
+        "fleet_pending": fleet.pending,
+        "ticks": ticks,
+        "virtual_s": clock(),
+        "tokens": tokens,
+        "e2e_served": len(e2e_ok),
+        "e2e_p50_s": _pct(e2e_ok, 50),
+        "e2e_p99_s": _pct(e2e_ok, 99),
+        "slo_attainment": attainment,
+        "ttft_p50_s": _pct(ttfts, 50),
+        "retries": fleet.retries,
+        "hedges": fleet.hedges,
+        "migrations": fleet.migrations,
+        "degraded_max_level": degraded_max,
+        "health_log": list(fleet.health_log),
+        "fault_log": list(injector.log) if injector is not None else [],
+        "recovery": fleet.recovery_report(),
+    }
 
 
 def main(argv=None) -> int:
@@ -191,6 +440,37 @@ def main(argv=None) -> int:
     ap.add_argument("--chunked", action="store_true",
                     help="chunked prefill via the tick scheduler")
     ap.add_argument("--token-budget", type=int, default=64)
+    ap.add_argument("--client-retries", type=int, default=3,
+                    help="client resubmits a shed request up to N times, "
+                    "honoring its retry_after_s with jitter (0: drop)")
+    # chaos scenarios (FleetRouter on a virtual clock)
+    ap.add_argument("--scenario", choices=SCENARIOS, default=None,
+                    help="run a fleet chaos scenario instead of the "
+                    "wall-clock loadgen")
+    ap.add_argument("--tick-s", type=float, default=0.02,
+                    help="virtual seconds per fleet tick")
+    ap.add_argument("--e2e-slo-s", type=float, default=3.0,
+                    help="end-to-end SLO asserted by the scenarios "
+                    "(virtual seconds)")
+    ap.add_argument("--max-ticks", type=int, default=5000)
+    ap.add_argument("--retry-budget", type=int, default=4)
+    ap.add_argument("--hedge-after-s", type=float, default=None,
+                    help="hedge a first-token-less request after this "
+                    "many (virtual) seconds; default: no hedging")
+    ap.add_argument("--ladder-step-down-s", type=float, default=0.5)
+    ap.add_argument("--kill-tick", type=int, default=6)
+    ap.add_argument("--kill-replica", type=int, default=1)
+    ap.add_argument("--kill-duration", type=int, default=10 ** 6,
+                    help="crash length in ticks (default: permanent)")
+    ap.add_argument("--slow-tick", type=int, default=4)
+    ap.add_argument("--slow-s", type=float, default=0.1,
+                    help="extra virtual seconds per tick on the slow "
+                    "replica")
+    ap.add_argument("--slow-duration", type=int, default=40)
+    ap.add_argument("--burst-n", type=int, default=8)
+    ap.add_argument("--burst-gap-s", type=float, default=0.5)
+    ap.add_argument("--period-s", type=float, default=4.0,
+                    help="diurnal modulation period (virtual seconds)")
     # workload shape
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--min-prompt", type=int, default=8)
@@ -209,6 +489,34 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
 
+    if args.scenario is not None:
+        report = run_scenario(args)
+        if args.json:
+            print(json.dumps(report, indent=2))
+            return 0
+        print(f"scenario {report['scenario']}: "
+              f"{report['responses']}/{report['submitted']} answered "
+              f"(lost {len(report['lost'])}, dup {report['duplicated']}, "
+              f"client-shed {report['shed_client']}) "
+              f"in {report['ticks']} ticks / {report['virtual_s']:.2f}s "
+              "virtual")
+        print(f"  outcomes {report['outcomes']}")
+        print(f"  slo attainment {report['slo_attainment']:.0%} "
+              f"(e2e p50 {report['e2e_p50_s'] * 1e3:.0f} ms, "
+              f"p99 {report['e2e_p99_s'] * 1e3:.0f} ms vs "
+              f"{args.e2e_slo_s:.1f}s)")
+        print(f"  retries {report['retries']}  hedges {report['hedges']}  "
+              f"migrations {report['migrations']}  "
+              f"degraded<= {report['degraded_max_level']}")
+        if report["health_log"]:
+            print(f"  health transitions {report['health_log']}")
+        rec = report["recovery"]
+        if rec["first_dead"]:
+            print(f"  recovery: dead@{rec['first_dead']}  "
+                  f"migrated@{rec['first_migration']}  "
+                  f"resumed@{rec['first_resumed_token']}")
+        return 0
+
     report = run_loadgen(args)
     if args.json:
         print(json.dumps(report, indent=2))
@@ -217,6 +525,8 @@ def main(argv=None) -> int:
           f"(shed {report['shed']}, "
           f"{report['shed_fraction']:.0%}) in {report['wall_s']:.2f}s "
           f"-> {report['throughput_tok_s']:.0f} tok/s")
+    print(f"  outcomes {report['outcomes']}  "
+          f"client retries {report['client_retries']}")
     print(f"  ttft  p50 {report['ttft_p50_s'] * 1e3:8.1f} ms   "
           f"p90 {report['ttft_p90_s'] * 1e3:8.1f} ms   "
           f"p99 {report['ttft_p99_s'] * 1e3:8.1f} ms")
