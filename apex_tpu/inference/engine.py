@@ -47,6 +47,7 @@ import numpy as np
 
 from apex_tpu.inference.kv_cache import KVCache
 from apex_tpu.inference.sampling import SamplingParams, sample
+from apex_tpu.observability.fleetobs import TraceContext
 from apex_tpu.observability.request_trace import RequestTracer
 from apex_tpu.utils.profiling import ServingMetrics
 
@@ -69,7 +70,11 @@ class Request:
     longer wanted"; timeout answers "this request used up its share").
     ``seed`` feeds the per-request sampling stream (stochastic modes
     only) — streams are keyed by (seed, token index), never by batch
-    composition.
+    composition.  ``trace`` is the fleet-wide causal identity
+    (:class:`~apex_tpu.observability.fleetobs.TraceContext`): the
+    router mints it, the engines stamp flow events against it, and it
+    rides the request through retry/hedge/migration so the merged
+    timeline shows one connected flow per request.
     """
     request_id: int
     prompt: Sequence[int]
@@ -80,6 +85,7 @@ class Request:
     deadline: Optional[float] = None
     timeout: Optional[float] = None
     seed: int = 0
+    trace: Optional[TraceContext] = None
 
 
 @dataclasses.dataclass
@@ -251,7 +257,7 @@ class InferenceEngine:
                 f"{self.max_queue}); retry after step() drains it")
         self._submit_time[request.request_id] = self.clock()
         self.metrics.request_submitted(request.request_id)
-        self.trace.enqueue(request.request_id)
+        self.trace.enqueue(request.request_id, ctx=request.trace)
         self._queue.append(request)
 
     @property
@@ -493,6 +499,7 @@ class InferenceEngine:
                 # re-enters the throughput series only
                 self.metrics.token(req.request_id)
                 self.trace.decode_tick(req.request_id)
+                self.trace.resumed(req.request_id)
             st = _Active(req, plen, next_token=nxt, position=clen,
                          generated=(prev or []) + [nxt])
             self._active[slot] = st

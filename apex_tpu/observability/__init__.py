@@ -28,8 +28,18 @@ The MEASURED layer on top (ISSUE 7):
   percentiles + declarative :class:`SLOTarget`\\ s + multi-window
   burn-rate alerts.
 
+The FLEET layer on top (ISSUE 13):
+
+* :mod:`~apex_tpu.observability.fleetobs` — :class:`TraceContext`
+  causal propagation (router-minted, engine-stamped Chrome flow
+  events that stitch a request's journey across replicas),
+  :class:`FleetCollector` (N-replica clock-aligned merged timelines +
+  fleet-level SLO burn), :func:`check_flows` (measured trace
+  continuity), and the :class:`FlightRecorder` anomaly black box.
+
 ``tools/metrics_report.py`` renders a JSONL stream into a human
 summary (``--trace`` merges it with a span trace onto one timeline);
+``tools/fleet_report.py`` does the N-replica version;
 ``docs/source/observability.md`` is the user guide.
 """
 
@@ -57,6 +67,13 @@ from apex_tpu.observability.costmodel import (
     fit_cost_model,
     load_profile,
     probe_collectives,
+)
+from apex_tpu.observability.fleetobs import (
+    FleetCollector,
+    FlightRecorder,
+    TraceContext,
+    check_flows,
+    emit_flow,
 )
 from apex_tpu.observability.request_trace import RequestRecord, RequestTracer
 from apex_tpu.observability.slo import (
@@ -86,6 +103,11 @@ __all__ = [
     "fit_cost_model",
     "load_profile",
     "probe_collectives",
+    "FleetCollector",
+    "FlightRecorder",
+    "TraceContext",
+    "check_flows",
+    "emit_flow",
     "RequestRecord",
     "RequestTracer",
     "BurnWindow",

@@ -34,6 +34,7 @@ import dataclasses
 import time
 from typing import Optional
 
+from apex_tpu.observability.fleetobs import TraceContext, emit_flow
 from apex_tpu.observability.spans import Tracer
 
 
@@ -43,6 +44,7 @@ class _Live:
     t_admit: Optional[float] = None
     t_first: Optional[float] = None
     ticks: int = 0                 # decode ticks (tokens after the first)
+    ctx: Optional[TraceContext] = None   # fleet-wide causal identity
 
 
 @dataclasses.dataclass
@@ -98,8 +100,10 @@ class RequestTracer:
 
     # -- lifecycle (hot path: timestamps only) -------------------------------
 
-    def enqueue(self, request_id) -> None:
-        self._live[request_id] = _Live(t_enqueue=self.clock())
+    def enqueue(self, request_id,
+                ctx: Optional[TraceContext] = None) -> None:
+        self._live[request_id] = _Live(t_enqueue=self.clock(), ctx=ctx)
+        self._flow(ctx, "enqueue", request_id=request_id)
 
     def admit(self, request_id) -> None:
         st = self._live.get(request_id)
@@ -109,11 +113,25 @@ class RequestTracer:
         if self.metrics is not None:
             self.metrics.request_admitted(request_id,
                                           st.t_admit - st.t_enqueue)
+        self._flow(st.ctx, "admit", request_id=request_id)
 
     def first_token(self, request_id) -> None:
         st = self._live.get(request_id)
         if st is not None:
             st.t_first = self.clock()
+            self._flow(st.ctx, "first_token", request_id=request_id)
+
+    def resumed(self, request_id) -> None:
+        """A migrated/preempted request re-entered decode with prior
+        progress intact — a flow step, so the cross-replica arrow
+        lands on the adopting replica's lane."""
+        st = self._live.get(request_id)
+        if st is not None:
+            self._flow(st.ctx, "resume", request_id=request_id)
+
+    def _flow(self, ctx, phase, *, final=False, **args) -> None:
+        if self.tracer is not None:
+            emit_flow(self.tracer, ctx, phase, final=final, **args)
 
     def decode_tick(self, request_id) -> None:
         st = self._live.get(request_id)
@@ -185,6 +203,12 @@ class RequestTracer:
         self.records.append(rec)
         if self.metrics is not None and st.t_admit is not None:
             self.metrics.request_decode_ticks(request_id, st.ticks)
+        # "migrated" is a flow STEP (the chain continues on the
+        # adopting replica); every other reason terminates the flow
+        self._flow(st.ctx,
+                   "migrate_out" if reason == "migrated" else "finish",
+                   final=reason != "migrated",
+                   request_id=request_id, reason=reason)
         tr = self.tracer
         if tr is not None:
             args = {"reason": reason, "ticks": st.ticks}

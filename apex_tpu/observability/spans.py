@@ -22,6 +22,7 @@ that chrome://tracing and https://ui.perfetto.dev open directly.
 from __future__ import annotations
 
 import contextlib
+import itertools
 import json
 import os
 import threading
@@ -30,13 +31,31 @@ from typing import Optional
 
 import jax
 
+# monotone instance counter: two Tracers in ONE process (an in-process
+# test fleet) must still mint distinct id tags, so pid alone is not
+# enough — see `Tracer.id_tag`
+_INSTANCE_SEQ = itertools.count()
+
 
 class Tracer:
     """Collects spans into a Chrome trace-event list.  Thread-safe;
-    ``clock`` is injectable (seconds; default ``time.perf_counter``)."""
+    ``clock`` is injectable (seconds; default ``time.perf_counter``).
 
-    def __init__(self, clock=time.perf_counter):
+    ``id_tag`` namespaces this tracer's async-event ids so traces from
+    several replicas merge without (cat, id) collisions: each replica's
+    id counters used to restart at 0, and Perfetto folds same-id flows
+    from different files onto one row.  The default tag is
+    ``"<pid hex>.<instance #>"`` — unique across processes AND across
+    tracers within one process.  Flow events (:meth:`flow`) are the one
+    deliberate exception: their ids must MATCH across replicas (that is
+    how a migrated request's fragments stitch), so they are never
+    prefixed."""
+
+    def __init__(self, clock=time.perf_counter, *,
+                 id_tag: Optional[str] = None):
         self.clock = clock
+        self.id_tag = (id_tag if id_tag is not None
+                       else f"{os.getpid():x}.{next(_INSTANCE_SEQ)}")
         self._lock = threading.Lock()
         self._events: list = []
         self._local = threading.local()
@@ -115,7 +134,7 @@ class Tracer:
         this tracer's clock).  Emitted after the fact — the request
         tracer records raw timestamps on the hot path and materializes
         trace events once, at request completion."""
-        ident = str(id)
+        ident = f"{self.id_tag}/{id}"
         pid = os.getpid()
         begin = {"name": name, "ph": "b", "cat": cat, "id": ident,
                  "ts": ts * 1e6, "pid": pid, "tid": pid}
@@ -131,12 +150,46 @@ class Tracer:
                       cat: str = "request", **args) -> None:
         """A point event (``ph: "n"``) on flow ``(cat, id)`` — decode
         ticks, admission edges."""
-        ev = {"name": name, "ph": "n", "cat": cat, "id": str(id),
+        ev = {"name": name, "ph": "n", "cat": cat,
+              "id": f"{self.id_tag}/{id}",
               "ts": ts * 1e6, "pid": os.getpid(), "tid": os.getpid()}
         if args:
             ev["args"] = dict(args)
         with self._lock:
             self._events.append(ev)
+
+    # -- flow events (cross-replica causality) -------------------------------
+    #
+    # Chrome stitches flow events sharing (cat, name, id) into one
+    # arrow chain across tracks — and, after a merge, across replicas.
+    # Fixed cat/name ("reqflow"/"request") keep the stitch key down to
+    # the id alone; the id is the fleet-wide trace id and is therefore
+    # NOT namespaced by `id_tag` (matching across replicas is the
+    # point).
+
+    FLOW_CAT = "reqflow"
+    FLOW_NAME = "request"
+
+    def flow(self, ph: str, id: object, ts: Optional[float] = None,
+             **args) -> dict:
+        """One flow event: ``ph`` is ``"s"`` (start), ``"t"`` (step) or
+        ``"f"`` (end).  ``ts`` is seconds on this tracer's clock
+        (default: now).  Returns the event dict (callers stash the span
+        id they put in ``args`` to parent the next hop)."""
+        if ph not in ("s", "t", "f"):
+            raise ValueError(f"flow ph must be s/t/f, got {ph!r}")
+        pid = os.getpid()
+        ev = {"name": self.FLOW_NAME, "ph": ph, "cat": self.FLOW_CAT,
+              "id": str(id),
+              "ts": (self.clock() if ts is None else ts) * 1e6,
+              "pid": pid, "tid": pid}
+        if ph == "f":
+            ev["bp"] = "e"          # bind the arrow to the enclosing slice
+        if args:
+            ev["args"] = dict(args)
+        with self._lock:
+            self._events.append(ev)
+        return ev
 
     # -- export --------------------------------------------------------------
 

@@ -535,7 +535,7 @@ class ElasticTrainer:
 
     def __init__(self, factory, plan: ElasticPlan, *, directory: str,
                  fault_injector=None, signals: Optional[HostSignals] = None,
-                 registry=None, tracer=None, keep: int = 3,
+                 registry=None, tracer=None, recorder=None, keep: int = 3,
                  save_every: int = 1, devices=None,
                  clock: Callable[[], float] = time.perf_counter):
         from apex_tpu.resilience.checkpoint import CheckpointManager
@@ -546,6 +546,10 @@ class ElasticTrainer:
         self.fault_injector = fault_injector
         self.signals = signals
         self.tracer = tracer
+        # optional flight recorder (fleetobs.FlightRecorder): per-step
+        # entries feed its "trainer" ring; a guard rollback cuts a
+        # correlated snapshot — the training-side black-box trigger
+        self.recorder = recorder
         self.save_every = max(1, int(save_every))
         self.clock = clock
         self._devices = (list(devices) if devices is not None
@@ -588,6 +592,9 @@ class ElasticTrainer:
             self._c_signals.inc()
         if self.tracer is not None:
             self.tracer.instant("elastic/signal", step=step, kind=kind)
+        if self.recorder is not None:
+            self.recorder.record("trainer", "signal", step=step,
+                                 kind=kind)
 
     def _resumed_at(self, step: int) -> None:
         self.stats["resume_step"] = int(step)
@@ -725,6 +732,11 @@ class ElasticTrainer:
             self._c_replans.inc()
         if self._h_reshard is not None:
             self._h_reshard.observe(dt)
+        if self.recorder is not None:
+            self.recorder.record("trainer", "replan", step=step,
+                                 old=old_plan.spec.describe(),
+                                 new=new_spec.describe(),
+                                 reshard_s=dt)
         self._resumed_at(step)
 
     # -- signal polling ------------------------------------------------------
@@ -797,6 +809,13 @@ class ElasticTrainer:
             self._params, self._opt = res.params, res.opt_state
             self._gstate, self._sstate = res.guard_state, res.scaler_state
             step = res.next_step
+            if self.recorder is not None:
+                self.recorder.record("trainer", "step", step=step,
+                                     loss=float(res.loss_value),
+                                     rolled_back=bool(res.rolled_back))
+                if res.rolled_back:
+                    self.recorder.trigger("guard_rollback", step=step,
+                                          loss=float(res.loss_value))
             if step % self.save_every == 0 or res.rolled_back:
                 self._save(step)
         self._final_step = step

@@ -222,6 +222,7 @@ class PagedInferenceEngine(InferenceEngine):
             else:
                 self.metrics.token(req.request_id)
                 self.trace.decode_tick(req.request_id)
+                self.trace.resumed(req.request_id)
             st = _Active(req, len(req.prompt), next_token=nxt,
                          position=clen, generated=(prev or []) + [nxt])
             self._active[slot] = st
@@ -346,6 +347,7 @@ class PagedInferenceEngine(InferenceEngine):
         else:
             self.metrics.token(st.request.request_id)
             self.trace.decode_tick(st.request.request_id)
+            self.trace.resumed(st.request.request_id)
         st.next_token = nxt
         st.generated.append(nxt)
         del self._prefilling[slot]

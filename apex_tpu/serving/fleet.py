@@ -71,6 +71,7 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 import numpy as np
 
 from apex_tpu.inference.engine import QueueFull, Request, Response
+from apex_tpu.observability.fleetobs import FlightRecorder, emit_flow
 from apex_tpu.resilience.faults import seeded_schedule
 from apex_tpu.serving.router import RequestShed, Router, ShedReason
 
@@ -297,6 +298,7 @@ class FleetRouter(Router):
                  retry_jitter: float = 0.5,
                  hedge_after_s: Optional[float] = None,
                  ladder: Optional[DegradationLadder] = None,
+                 recorder: Optional[FlightRecorder] = None,
                  seed: int = 0, registry=None, **kw):
         super().__init__(replicas, registry=registry, **kw)
         if suspect_after < 1 or dead_after <= suspect_after:
@@ -316,6 +318,7 @@ class FleetRouter(Router):
         self.retry_jitter = retry_jitter
         self.hedge_after_s = hedge_after_s
         self.ladder = ladder
+        self.recorder = recorder
         self._rng = np.random.RandomState(seed)
         self._tick = 0
         self._state = [_ReplicaState() for _ in self.replicas]
@@ -351,6 +354,11 @@ class FleetRouter(Router):
         self._g_degraded = r.gauge(
             "serving_degraded_level",
             "graceful-degradation ladder level (0 normal .. 3 shed)")
+        self._c_trans = r.counter(
+            "serving_replica_transitions_total",
+            "health state-machine transitions by edge — flapping "
+            "(healthy<->suspect cycles) vs clean deaths",
+            labelnames=("from", "to"))
         self._g_degraded.set(0)
         self._set_health_gauges()
 
@@ -363,12 +371,30 @@ class FleetRouter(Router):
         st = self._state[i]
         if st.health is new:
             return
-        self.health_log.append((self._tick, i, st.health.value, new.value))
+        old = st.health.value
+        self.health_log.append((self._tick, i, old, new.value))
+        # "from" is a Python keyword — label kwargs go through a splat
+        self._c_trans.inc(**{"from": old, "to": new.value})
+        reg = getattr(self.replicas[i].metrics, "registry", None)
+        if reg is not None:
+            # into the REPLICA's stream, so a merged fleet report can
+            # attribute health history per replica
+            reg.event("replica_health", replica=i, state=new.value,
+                      previous=old)
+        if self.recorder is not None:
+            self.recorder.record(f"replica{i}", "health_transition",
+                                 tick=self._tick, old=old,
+                                 new=new.value)
         st.health = new
         if new is ReplicaHealth.DEAD:
             if self.first_dead is None:
                 self.first_dead = (self._tick, self.clock())
             self._on_dead(i)
+            if self.recorder is not None:
+                # cut the black box AFTER migration so the snapshot
+                # carries the re-placement decisions too
+                self.recorder.trigger("replica_dead", replica=i,
+                                      tick=self._tick)
 
     def _miss(self, i: int) -> None:
         st = self._state[i]
@@ -443,6 +469,7 @@ class FleetRouter(Router):
             if self.ladder.level >= 3:
                 self.shed_requests += 1
                 self._c_shed.inc()
+                self._flow_shed(request, ShedReason.DEGRADED)
                 raise RequestShed(
                     "degraded to shed level; retry after backoff",
                     reason=ShedReason.DEGRADED,
@@ -451,10 +478,12 @@ class FleetRouter(Router):
                     and len(request.prompt) > self._ctx_cap():
                 self.shed_requests += 1
                 self._c_shed.inc()
+                self._flow_shed(request, ShedReason.CONTEXT_CAP)
                 raise RequestShed(
                     f"degraded context cap {self._ctx_cap()} tokens",
                     reason=ShedReason.CONTEXT_CAP,
                     retry_after_s=self._retry_after_hint())
+        self._dispatch_ctx(request)
         i = self._try_place(request)
         if i is None:
             if self.retry_budget > 0:
@@ -464,12 +493,19 @@ class FleetRouter(Router):
             self._c_shed.inc()
             healthy = any(s.health is ReplicaHealth.HEALTHY
                           for s in self._state)
+            self._flow_shed(request,
+                            ShedReason.OVERLOAD if healthy
+                            else ShedReason.NO_HEALTHY_REPLICA)
             raise RequestShed(
                 "no eligible replica",
                 reason=(ShedReason.OVERLOAD if healthy
                         else ShedReason.NO_HEALTHY_REPLICA),
                 retry_after_s=self._retry_after_hint())
         self._inflight[request.request_id] = _InFlight(request, i, now)
+        if self.recorder is not None:
+            self.recorder.record("router", "place",
+                                 request_id=request.request_id,
+                                 replica=i, tick=self._tick)
         return i
 
     def _queue_retry(self, request: Request, progress: List[int],
@@ -531,6 +567,10 @@ class FleetRouter(Router):
             # longer fits a fresh admission anywhere useful
             self._router_finish(req, progress, "preempted")
             return
+        if req.trace is not None:
+            # next causal hop: the adopting replica's enqueue/resume
+            # flow events carry the bumped counter
+            req.trace.next_hop()
         try:
             eng.adopt(req, list(progress))
         except (QueueFull, ValueError):
@@ -540,6 +580,10 @@ class FleetRouter(Router):
         self.migrations += 1
         self._c_migrations.inc()
         eng.trace.migrate(rid, src, target)
+        if self.recorder is not None:
+            self.recorder.record("router", "migrate", request_id=rid,
+                                 src=src, dst=target, tick=self._tick,
+                                 progress=len(progress))
         if self.first_migration is None:
             self.first_migration = (self._tick, now)
         self._resume_watch[rid] = (target, len(progress))
@@ -555,6 +599,16 @@ class FleetRouter(Router):
         self._inflight.pop(req.request_id, None)
         self._responses[req.request_id] = Response(
             req.request_id, list(req.prompt), list(tokens), reason)
+        if req.trace is not None and req.trace.started:
+            # terminal at the ROUTER (shed/preempted) — no engine will
+            # close this flow
+            emit_flow(self._router_tracer(), req.trace, "finish",
+                      final=True, request_id=req.request_id,
+                      reason=reason)
+        if self.recorder is not None:
+            self.recorder.record("router", "router_finish",
+                                 request_id=req.request_id,
+                                 reason=reason, tick=self._tick)
 
     # -- response collection -------------------------------------------------
 
@@ -569,6 +623,11 @@ class FleetRouter(Router):
                     self.duplicate_responses += 1
                     continue
                 self._responses[rid] = resp
+                if self.recorder is not None:
+                    self.recorder.record(f"replica{i}", "response",
+                                         request_id=rid,
+                                         reason=resp.finish_reason,
+                                         tick=self._tick)
                 self._resume_watch.pop(rid, None)
                 fl = self._inflight.pop(rid, None)
                 if fl is not None and fl.hedge_replica is not None:
@@ -614,6 +673,9 @@ class FleetRouter(Router):
             self.hedges += 1
             self._c_hedges.inc()
             self.replicas[target].trace.hedge(rid, target)
+            if self.recorder is not None:
+                self.recorder.record("router", "hedge", request_id=rid,
+                                     replica=target, tick=self._tick)
 
     def _retry_pass(self) -> None:
         now = self.clock()
@@ -630,6 +692,10 @@ class FleetRouter(Router):
             self.retries += 1
             self._c_retries.inc()
             self.replicas[0].trace.retry(rid, pr.attempts)
+            if self.recorder is not None:
+                self.recorder.record("router", "retry", request_id=rid,
+                                     attempt=pr.attempts,
+                                     tick=self._tick)
             if pr.progress:
                 # in-flight work is never shed by the budget: _migrate
                 # places it, finishes it ("preempted"), or re-queues it
@@ -660,6 +726,12 @@ class FleetRouter(Router):
             return
         self._g_degraded.set(lvl)
         self.replicas[0].trace.degrade(lvl)
+        if self.recorder is not None:
+            self.recorder.record("router", "degrade", old=old, new=lvl,
+                                 burn=burn, tick=self._tick)
+            if lvl > old:
+                self.recorder.trigger("ladder_escalation", level=lvl,
+                                      burn=burn, tick=self._tick)
         for eng in self.replicas:
             if getattr(eng, "spec", None) is not None:
                 eng.spec_enabled = lvl < 1
@@ -683,6 +755,10 @@ class FleetRouter(Router):
             kinds: Dict[str, ServingFault] = {}
             if self.injector is not None:
                 kinds = {f.kind: f for f in self.injector.activate(t, i)}
+            if self.recorder is not None:
+                for k in kinds:
+                    self.recorder.record(f"replica{i}", "fault",
+                                         fault=k, tick=t)
             eng.injected_faults = frozenset(
                 k for k in kinds
                 if k in ("reject_admission", "kv_pool_exhaustion"))
@@ -703,6 +779,13 @@ class FleetRouter(Router):
                 self._advance_clock(float(slow.magnitude) or 0.05)
             durations[i] = self.clock() - t0
             self._beat(i)
+            if self.recorder is not None:
+                # per-tick load deltas per replica — the "metric
+                # deltas" lane of the black box
+                self.recorder.record(f"replica{i}", "tick",
+                                     tick=t, queue=eng.queue_depth,
+                                     active=eng.active_requests,
+                                     dur_s=durations[i])
         self._update_slow(durations)
         self._collect()
         self._check_resumed()

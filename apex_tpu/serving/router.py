@@ -34,6 +34,8 @@ import enum
 from typing import List, Optional, Sequence
 
 from apex_tpu.inference.engine import QueueFull, Request, Response
+from apex_tpu.observability.fleetobs import TraceContext, emit_flow
+from apex_tpu.observability.spans import Tracer
 
 
 class ShedReason(enum.Enum):
@@ -72,7 +74,8 @@ class Router:
                  max_queue_depth: int = 8,
                  burn_threshold: float = 14.4,
                  burn_window_s: float = 60.0,
-                 registry=None):
+                 registry=None,
+                 tracer: Optional[Tracer] = None):
         if not replicas:
             raise ValueError("need at least one replica")
         if max_queue_depth < 1:
@@ -82,6 +85,14 @@ class Router:
         self.burn_threshold = burn_threshold
         self.burn_window_s = burn_window_s
         self.shed_requests = 0
+        # the router's own trace lane (dispatch/shed flow events); a
+        # TraceContext is minted whenever ANY tracer exists in the
+        # deployment, so engine-side flows link up even without a
+        # router tracer
+        self.tracer = tracer
+        self._tracing = tracer is not None or any(
+            getattr(getattr(e, "trace", None), "tracer", None) is not None
+            for e in self.replicas)
         r = registry if registry is not None \
             else self.replicas[0].metrics.registry
         self._c_submitted = r.counter(
@@ -129,6 +140,37 @@ class Router:
 
     # -- admission -----------------------------------------------------------
 
+    def _dispatch_ctx(self, request: Request) -> Optional[TraceContext]:
+        """Mint the request's :class:`TraceContext` (once — retries
+        reuse it) and stamp the router's dispatch flow event."""
+        if not self._tracing:
+            return None
+        if request.trace is None:
+            request.trace = TraceContext.mint(request.request_id)
+        emit_flow(self.tracer, request.trace, "dispatch",
+                  request_id=request.request_id)
+        return request.trace
+
+    def _router_tracer(self) -> Optional[Tracer]:
+        """The tracer router-level flow events land on: the router's
+        own, else any replica's (a started chain must still close when
+        only the engines are traced)."""
+        if self.tracer is not None:
+            return self.tracer
+        for e in self.replicas:
+            t = getattr(getattr(e, "trace", None), "tracer", None)
+            if t is not None:
+                return t
+        return None
+
+    def _flow_shed(self, request: Request, reason: "ShedReason") -> None:
+        """Terminate a shed request's flow at the router (it never
+        reaches an engine, so nothing else will)."""
+        if request.trace is not None and request.trace.started:
+            emit_flow(self._router_tracer(), request.trace, "finish",
+                      final=True, request_id=request.request_id,
+                      reason="shed", shed_reason=reason.value)
+
     def _try_place(self, request: Request) -> Optional[int]:
         """Place on the best eligible replica; replica index, or None
         with nowhere to go (the :class:`QueueFull` race — an eligible
@@ -155,11 +197,13 @@ class Router:
         """Place ``request`` on the best eligible replica; returns the
         replica index.  Raises :class:`RequestShed` when no replica is
         eligible."""
+        self._dispatch_ctx(request)
         i = self._try_place(request)
         if i is not None:
             return i
         self.shed_requests += 1
         self._c_shed.inc()
+        self._flow_shed(request, ShedReason.OVERLOAD)
         raise RequestShed(
             f"all {len(self.replicas)} replicas overloaded "
             f"(max_queue_depth={self.max_queue_depth}, "

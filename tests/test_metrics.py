@@ -650,8 +650,35 @@ class TestTracerExceptionPath:
         tr.async_instant("tick", 7, ts=1.2)
         b, e, n = tr.events
         assert (b["ph"], e["ph"], n["ph"]) == ("b", "e", "n")
-        assert b["id"] == e["id"] == n["id"] == "7"
+        # async ids are namespaced by the tracer's replica tag so two
+        # replicas' id counters never collide in a merged trace
+        assert b["id"] == e["id"] == n["id"] == f"{tr.id_tag}/7"
         assert b["cat"] == "request" and b["ts"] == pytest.approx(1e6)
         assert e["ts"] == pytest.approx(1.5e6)
         assert b["args"] == {"reason": "eos"}
         json.loads(tr.to_json())
+
+    def test_async_ids_unique_across_tracers(self):
+        a, b = Tracer(clock=lambda: 0.0), Tracer(clock=lambda: 0.0)
+        a.async_span("request", 7, ts=0.0, dur=1.0)
+        b.async_span("request", 7, ts=0.0, dur=1.0)
+        ids_a = {e["id"] for e in a.events}
+        ids_b = {e["id"] for e in b.events}
+        assert not ids_a & ids_b
+
+    def test_flow_events(self):
+        tr = Tracer(clock=lambda: 3.0)
+        s = tr.flow("s", "req:1", phase="dispatch")
+        t = tr.flow("t", "req:1", 4.0, phase="admit")
+        f = tr.flow("f", "req:1", phase="finish")
+        assert [e["ph"] for e in tr.events] == ["s", "t", "f"]
+        # flow ids are NOT tag-prefixed: they must match across
+        # replicas — that is how migrated fragments stitch
+        assert all(e["id"] == "req:1" for e in (s, t, f))
+        assert all(e["cat"] == Tracer.FLOW_CAT for e in (s, t, f))
+        assert all(e["name"] == Tracer.FLOW_NAME for e in (s, t, f))
+        assert t["ts"] == pytest.approx(4e6)
+        assert s["ts"] == f["ts"] == pytest.approx(3e6)
+        assert f["bp"] == "e"
+        with pytest.raises(ValueError):
+            tr.flow("x", "req:1")

@@ -170,7 +170,7 @@ class TestSpanEmission:
                                 "decode"}
         for name, pair in by_name.items():
             assert [e["ph"] for e in pair] == ["b", "e"]
-            assert all(e["id"] == "7" for e in pair)
+            assert all(e["id"] == f"{tr.id_tag}/7" for e in pair)
             assert all(e["cat"] == "request" for e in pair)
         # µs timestamps tile: queue 0-1s, prefill 1-2s, decode 2-5s
         def span_us(name):

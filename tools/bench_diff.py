@@ -1,0 +1,263 @@
+#!/usr/bin/env python
+"""Bench-trajectory regression gate: diff bench.py outputs across rounds.
+
+The repo accumulates one committed ``BENCH_rNN.json`` per round — the
+bench trajectory — but until now nothing READ that trajectory; a leg
+that quietly lost 20% would sit in the diff of two JSON blobs nobody
+rendered.  This tool is the automated reader:
+
+* extracts the per-leg metric dicts from a bench artifact — the
+  ``parsed`` field when the round recorded one, else the last
+  ``{"metric": ...}`` JSON line in the captured ``tail``, else (the
+  tail is a byte-truncated suffix, so the line may be headless) a
+  balanced-brace scan that recovers every complete per-leg dict;
+* pairs the numeric series leg-by-leg between the two rounds,
+  classifies each key's direction (``mfu`` / ``*_speedup`` /
+  ``tokens_per_s`` higher-better; ``*_s`` / ``*overhead*`` / latency
+  percentiles lower-better; unknown keys are reported, never flagged);
+* flags relative regressions beyond ``--threshold`` (default 10%).
+
+Usage:
+    python tools/bench_diff.py                  # two newest committed rounds
+    python tools/bench_diff.py current.json     # current output vs newest
+    python tools/bench_diff.py --threshold 0.2 --json
+    python tools/bench_diff.py --strict         # exit 1 on regression
+
+``__graft_entry__`` runs this as a NON-fatal report step after the CI
+legs — the gate informs; the tier-1 tests decide.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# direction classification by key content; HIGHER is matched first so
+# "tokens_per_s" lands as higher-better despite its "_s" suffix
+HIGHER_BETTER = ("speedup", "mfu", "tokens_per_s", "tok_s", "throughput",
+                 "attainment", "goodput", "acceptance", "accepted",
+                 "hit_rate", "flops", "fraction")
+LOWER_BETTER = ("overhead", "bubble", "ttft", "tpot", "latency",
+                "_us", "_s", "seconds", "bytes")
+
+
+def direction(key: str) -> int:
+    """+1 higher-better, -1 lower-better, 0 unknown."""
+    k = key.lower()
+    for pat in HIGHER_BETTER:
+        if pat in k:
+            return 1
+    for pat in LOWER_BETTER:
+        if pat in k:
+            return -1
+    return 0
+
+
+def committed_rounds():
+    """Committed bench artifacts, oldest -> newest (by round number;
+    ``*_local`` scratch files are skipped)."""
+    out = []
+    for p in glob.glob(os.path.join(REPO, "BENCH_r*.json")):
+        m = re.fullmatch(r"BENCH_r(\d+)\.json", os.path.basename(p))
+        if m:
+            out.append((int(m.group(1)), p))
+    return [p for _, p in sorted(out)]
+
+
+def _scan_legs(text: str) -> dict:
+    """Recover complete ``"name": {...}`` dicts with numeric leaves
+    from (possibly head-truncated) bench output text."""
+    legs = {}
+    for m in re.finditer(r'"([A-Za-z0-9_]+)":\s*\{', text):
+        start = m.end() - 1
+        depth = 0
+        for i in range(start, len(text)):
+            if text[i] == "{":
+                depth += 1
+            elif text[i] == "}":
+                depth -= 1
+                if depth == 0:
+                    try:
+                        obj = json.loads(text[start:i + 1])
+                    except ValueError:
+                        break
+                    if isinstance(obj, dict) and any(
+                            isinstance(v, (int, float))
+                            and not isinstance(v, bool)
+                            for v in obj.values()):
+                        legs.setdefault(m.group(1), obj)
+                    break
+        if depth > 0:               # unterminated: tail ends mid-dict
+            break
+    legs.pop("extra", None)         # the container, not a leg
+    return legs
+
+
+def _record_legs(rec: dict) -> dict:
+    legs = {k: v for k, v in rec.get("extra", {}).items()
+            if isinstance(v, dict)}
+    if "value" in rec and isinstance(rec.get("value"), (int, float)):
+        legs["headline"] = {rec.get("metric", "value"): rec["value"]}
+    return legs
+
+
+def extract_legs(path: str) -> dict:
+    """Per-leg numeric dicts from a bench artifact: a round file
+    (``parsed``/``tail``), a raw bench stdout capture, or a bare bench
+    JSON line."""
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    try:
+        obj = json.loads(text)
+    except ValueError:
+        obj = None
+    if isinstance(obj, dict):
+        if isinstance(obj.get("parsed"), dict) and "metric" in obj["parsed"]:
+            return _record_legs(obj["parsed"])
+        if "metric" in obj:
+            return _record_legs(obj)
+        text = obj.get("tail", "") or text
+    for line in reversed(text.splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec:
+            return _record_legs(rec)
+    return _scan_legs(text)
+
+
+def _flatten(d: dict, prefix: str = "") -> dict:
+    out = {}
+    for k, v in d.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(_flatten(v, f"{key}."))
+        elif isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[key] = float(v)
+    return out
+
+
+def diff_legs(old: dict, new: dict, threshold: float = 0.1) -> dict:
+    """Compare leg-by-leg; returns ``{"rows": [...], "regressions":
+    [...], "legs_compared": n, "legs_only_old": [...],
+    "legs_only_new": [...]}``."""
+    rows, regressions = [], []
+    shared = sorted(set(old) & set(new))
+    for leg in shared:
+        fo, fn = _flatten(old[leg]), _flatten(new[leg])
+        for key in sorted(set(fo) & set(fn)):
+            vo, vn = fo[key], fn[key]
+            d = direction(key)
+            if abs(vo) < 1e-12:
+                continue
+            rel = (vn - vo) / abs(vo)
+            regressed = (d == 1 and rel < -threshold) \
+                or (d == -1 and rel > threshold)
+            row = {"leg": leg, "key": key, "old": vo, "new": vn,
+                   "rel_change": rel,
+                   "direction": {1: "higher_better", -1: "lower_better",
+                                 0: "unknown"}[d],
+                   "regressed": bool(regressed)}
+            rows.append(row)
+            if regressed:
+                regressions.append(row)
+    return {"rows": rows, "regressions": regressions,
+            "legs_compared": len(shared),
+            "legs_only_old": sorted(set(old) - set(new)),
+            "legs_only_new": sorted(set(new) - set(old))}
+
+
+def render(result: dict, old_path: str, new_path: str,
+           threshold: float, out=sys.stdout) -> None:
+    out.write(f"bench diff: {os.path.basename(old_path)} -> "
+              f"{os.path.basename(new_path)} "
+              f"(threshold {threshold:.0%})\n")
+    out.write(f"legs compared: {result['legs_compared']}")
+    if result["legs_only_old"]:
+        out.write(f"  dropped: {','.join(result['legs_only_old'])}")
+    if result["legs_only_new"]:
+        out.write(f"  new: {','.join(result['legs_only_new'])}")
+    out.write("\n")
+    regs = result["regressions"]
+    if not regs:
+        out.write("no per-leg regressions beyond threshold\n")
+    for r in regs:
+        out.write(f"REGRESSION {r['leg']}.{r['key']}: "
+                  f"{r['old']:.6g} -> {r['new']:.6g} "
+                  f"({r['rel_change']:+.1%}, {r['direction']})\n")
+    # the biggest movers either way, for trend-watching
+    movers = sorted((r for r in result["rows"]
+                     if r["direction"] != "unknown"),
+                    key=lambda r: -abs(r["rel_change"]))[:5]
+    if movers:
+        out.write("top movers:\n")
+        for r in movers:
+            out.write(f"  {r['leg']}.{r['key']}: "
+                      f"{r['old']:.6g} -> {r['new']:.6g} "
+                      f"({r['rel_change']:+.1%})\n")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("current", nargs="?", default=None,
+                    help="current bench output (file with the bench "
+                         "JSON line); default: the newest committed "
+                         "round, compared against the one before it")
+    ap.add_argument("--against", default=None,
+                    help="baseline artifact; default: newest committed "
+                         "BENCH_r*.json (or second-newest when no "
+                         "current file is given)")
+    ap.add_argument("--threshold", type=float, default=0.1,
+                    help="relative regression threshold (default 0.10)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full diff as JSON")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 when any leg regressed")
+    args = ap.parse_args(argv)
+
+    rounds = committed_rounds()
+    if args.current is not None:
+        new_path = args.current
+        old_path = args.against or (rounds[-1] if rounds else None)
+    else:
+        if args.against is not None:
+            old_path = args.against
+            new_path = rounds[-1] if rounds else None
+        elif len(rounds) >= 2:
+            old_path, new_path = rounds[-2], rounds[-1]
+        else:
+            old_path = new_path = None
+    if old_path is None or new_path is None:
+        print("bench_diff: need two artifacts to compare "
+              "(no committed BENCH_r*.json rounds found)")
+        return 0
+
+    old_legs, new_legs = extract_legs(old_path), extract_legs(new_path)
+    if not old_legs or not new_legs:
+        print(f"bench_diff: could not extract per-leg metrics "
+              f"({old_path}: {len(old_legs)} legs, "
+              f"{new_path}: {len(new_legs)} legs)")
+        return 0
+    result = diff_legs(old_legs, new_legs, threshold=args.threshold)
+    if args.json:
+        json.dump({"old": old_path, "new": new_path,
+                   "threshold": args.threshold, **result},
+                  sys.stdout, indent=2)
+        sys.stdout.write("\n")
+    else:
+        render(result, old_path, new_path, args.threshold)
+    return 1 if (args.strict and result["regressions"]) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

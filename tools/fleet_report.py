@@ -1,0 +1,149 @@
+#!/usr/bin/env python
+"""Fleet-wide observability report: N replicas, one timeline, one view.
+
+``tools/metrics_report.py`` reads ONE replica's JSONL stream (and
+optionally merges it with one span trace).  This is the N-replica
+generalization, built on
+:class:`~apex_tpu.observability.fleetobs.FleetCollector`:
+
+* a per-replica table — last known health, requests finished, slot
+  occupancy, per-target SLO burn over the merged window;
+* fleet-level burn (every replica's raw histogram observations
+  replayed, in clock-aligned order, into one fleet SLOMonitor) and
+  ``fleet_*`` counter rollups;
+* trace-continuity summary over the merged flow events
+  (:func:`~apex_tpu.observability.fleetobs.check_flows`): complete vs
+  broken chains, orphan request slices;
+* ``--out merged.json`` — the single Perfetto-loadable merged timeline
+  with one process lane per replica and the applied clock offsets in
+  the trace metadata.
+
+Usage:
+    python tools/fleet_report.py \\
+        --replica r0=r0_trace.json,r0_metrics.jsonl \\
+        --replica r1=r1_trace.json,r1_metrics.jsonl \\
+        --out fleet_timeline.json
+
+Each ``--replica`` is ``NAME=TRACE_JSON[,METRICS_JSONL]`` (either file
+part may be empty, e.g. ``NAME=,METRICS_JSONL`` for a stream-only
+replica).  ``--json`` emits the whole report machine-readable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from apex_tpu.observability.fleetobs import FleetCollector  # noqa: E402
+
+
+def parse_replica(spec: str):
+    """``NAME=TRACE[,JSONL]`` -> (name, trace_path | None,
+    jsonl_path | None)."""
+    if "=" not in spec:
+        raise ValueError(
+            f"--replica {spec!r}: want NAME=TRACE_JSON[,METRICS_JSONL]")
+    name, _, paths = spec.partition("=")
+    trace_path, _, jsonl_path = paths.partition(",")
+    return name, (trace_path or None), (jsonl_path or None)
+
+
+def build_collector(specs) -> FleetCollector:
+    fc = FleetCollector()
+    for spec in specs:
+        name, trace_path, jsonl_path = parse_replica(spec)
+        fc.add_replica(name, trace_path=trace_path,
+                       jsonl_path=jsonl_path)
+    return fc
+
+
+def _fmt(v):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return str(v)
+
+
+def report(fc: FleetCollector, out=sys.stdout) -> dict:
+    rows = fc.replica_table()
+    burn = fc.fleet_burn()
+    series = fc.fleet_series()
+    cont = fc.continuity(require_finish=False)
+    data = {"replicas": rows, "fleet_burn": burn,
+            "fleet_series": series,
+            "continuity": {
+                "chains": len(cont["chains"]),
+                "complete": len(cont["complete"]),
+                "broken": cont["broken"],
+                "orphans": cont["orphans"]},
+            "offsets_us": fc.offsets_us()}
+
+    out.write("== replicas ==\n")
+    burn_keys = sorted({k for r in rows for k in r["burn"]})
+    header = ["replica", "health", "requests", "occupancy"] + \
+        [f"burn:{k}" for k in burn_keys] + ["span_events"]
+    table = [header]
+    for r in rows:
+        table.append([r["replica"], _fmt(r["health"]),
+                      _fmt(r["requests"]), _fmt(r["occupancy"])]
+                     + [_fmt(r["burn"].get(k)) for k in burn_keys]
+                     + [_fmt(r["span_events"])])
+    widths = [max(len(row[c]) for row in table)
+              for c in range(len(header))]
+    for row in table:
+        out.write("  ".join(c.ljust(w)
+                            for c, w in zip(row, widths)).rstrip() + "\n")
+
+    out.write("\n== fleet burn (merged streams) ==\n")
+    for k in sorted(burn):
+        out.write(f"{k}: {_fmt(burn[k])}\n")
+    if series:
+        out.write("\n== fleet rollups ==\n")
+        for k in sorted(series):
+            out.write(f"{k}: {_fmt(series[k])}\n")
+    out.write("\n== trace continuity ==\n")
+    out.write(f"chains: {len(cont['chains'])}  "
+              f"complete: {len(cont['complete'])}  "
+              f"broken: {len(cont['broken'])}  "
+              f"orphans: {len(cont['orphans'])}\n")
+    for tid, problems in sorted(cont["broken"].items()):
+        out.write(f"  {tid}: {'; '.join(problems)}\n")
+    offs = {k: v for k, v in fc.offsets_us().items() if v}
+    if offs:
+        out.write("\nclock offsets applied (us): "
+                  f"{json.dumps(offs)}\n")
+    return data
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--replica", action="append", required=True,
+                    metavar="NAME=TRACE[,JSONL]",
+                    help="one replica's trace file and/or JSONL stream "
+                         "(repeatable)")
+    ap.add_argument("--out", default=None, metavar="MERGED_JSON",
+                    help="also write the merged Perfetto timeline here")
+    ap.add_argument("--json", action="store_true",
+                    help="print the report as JSON instead of text")
+    args = ap.parse_args(argv)
+    fc = build_collector(args.replica)
+    if args.json:
+        data = report(fc, out=open(os.devnull, "w"))
+        json.dump(data, sys.stdout, indent=2)
+        sys.stdout.write("\n")
+    else:
+        report(fc)
+    if args.out:
+        fc.save(args.out)
+        n = len(fc.merged_timeline()["traceEvents"])
+        print(f"\nwrote {args.out}: {n} events")
+
+
+if __name__ == "__main__":
+    main()

@@ -99,13 +99,13 @@ def _build_model(args):
     return model, params
 
 
-def _build_replicas(args, model, params, clock):
+def _build_replicas(args, model, params, clock, tracers=None):
     from apex_tpu.observability.slo import SLOMonitor, SLOTarget
     from apex_tpu.serving import PagedInferenceEngine, TickScheduler
     from apex_tpu.utils.profiling import ServingMetrics
 
     replicas = []
-    for _ in range(args.replicas):
+    for i in range(args.replicas):
         slo = SLOMonitor([SLOTarget("ttft", args.ttft_slo_s,
                                     objective=0.9)], clock=clock)
         metrics = ServingMetrics(clock, slo=slo)
@@ -114,7 +114,8 @@ def _build_replicas(args, model, params, clock):
             block_size=args.block_size,
             chunked_prefill=args.chunked,
             scheduler=TickScheduler(token_budget=args.token_budget),
-            metrics=metrics, max_queue=args.max_queue, clock=clock))
+            metrics=metrics, max_queue=args.max_queue, clock=clock,
+            tracer=tracers[i] if tracers else None))
     return replicas
 
 
@@ -308,11 +309,18 @@ def synthesize_scenario(args):
 
 def build_fleet(args, clock):
     """(fleet, replicas, injector): the fault-tolerant stack on an
-    injectable clock."""
+    injectable clock, fully traced — one Tracer per replica plus a
+    router lane, so every scenario run can assert flow-chain
+    continuity over the merged timeline, and a FlightRecorder so
+    replica deaths / ladder escalations cut correlated snapshots."""
+    from apex_tpu.observability import FlightRecorder, Tracer
     from apex_tpu.serving import DegradationLadder, FleetRouter
 
     model, params = _build_model(args)
-    replicas = _build_replicas(args, model, params, clock)
+    tracers = [Tracer(clock=clock, id_tag=f"r{i}")
+               for i in range(args.replicas)]
+    replicas = _build_replicas(args, model, params, clock,
+                               tracers=tracers)
     injector = _scenario_injector(args)
     ladder = DegradationLadder(
         thresholds=(args.burn_threshold / 7.2, args.burn_threshold / 2.4,
@@ -325,8 +333,22 @@ def build_fleet(args, clock):
         burn_window_s=args.burn_window_s,
         retry_budget=args.retry_budget,
         hedge_after_s=args.hedge_after_s,
-        ladder=ladder, seed=args.seed)
+        ladder=ladder, seed=args.seed,
+        tracer=Tracer(clock=clock, id_tag="router"),
+        recorder=FlightRecorder(clock=clock))
     return fleet, replicas, injector
+
+
+def fleet_collector(fleet, replicas):
+    """A :class:`FleetCollector` over the stack's tracers (router lane
+    first, then one per replica)."""
+    from apex_tpu.observability import FleetCollector
+
+    fc = FleetCollector()
+    fc.add_replica("router", tracer=fleet.tracer)
+    for i, e in enumerate(replicas):
+        fc.add_replica(f"r{i}", tracer=e.trace.tracer)
+    return fc
 
 
 def run_scenario(args) -> dict:
@@ -391,6 +413,7 @@ def run_scenario(args) -> dict:
                   / len(e2e_ok)) if e2e_ok else 0.0
     ttfts = [t for e in replicas for t in e.metrics.ttft.values()]
     tokens = sum(len(r.tokens) for r in responses.values())
+    cont = fleet_collector(fleet, replicas).continuity()
     return {
         "scenario": args.scenario,
         "requests": args.requests,
@@ -417,6 +440,16 @@ def run_scenario(args) -> dict:
         "health_log": list(fleet.health_log),
         "fault_log": list(injector.log) if injector is not None else [],
         "recovery": fleet.recovery_report(),
+        "trace_continuity": {
+            "chains": len(cont["chains"]),
+            "complete": len(cont["complete"]),
+            "broken": cont["broken"],
+            "orphans": cont["orphans"],
+            "migrated_chains": sorted(
+                tid for tid, c in cont["chains"].items()
+                if c["migrated"]),
+        },
+        "flight_snapshots": len(fleet.recorder.dumps),
     }
 
 
@@ -510,6 +543,12 @@ def main(argv=None) -> int:
               f"degraded<= {report['degraded_max_level']}")
         if report["health_log"]:
             print(f"  health transitions {report['health_log']}")
+        tc = report["trace_continuity"]
+        print(f"  trace continuity: {tc['complete']}/{tc['chains']} "
+              f"chains complete, {len(tc['broken'])} broken, "
+              f"{len(tc['orphans'])} orphans, "
+              f"{len(tc['migrated_chains'])} migrated; "
+              f"{report['flight_snapshots']} flight snapshot(s)")
         rec = report["recovery"]
         if rec["first_dead"]:
             print(f"  recovery: dead@{rec['first_dead']}  "

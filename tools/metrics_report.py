@@ -45,6 +45,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 from apex_tpu.observability import Histogram, replay_jsonl  # noqa: E402
+from apex_tpu.observability.fleetobs import align_offset  # noqa: E402
 
 
 def _fmt(v):
@@ -136,11 +137,11 @@ def merge_trace(trace_events, lines):
         + [t * 1e6 for t, _, _ in records]
     # shared clock -> overlapping ranges -> no shift; disjoint ranges
     # (different epochs, e.g. perf_counter vs time.time) -> align mins
-    offset_us = 0.0
-    if span_ts and jsonl_ts:
-        if (min(jsonl_ts) > max(span_ts)
-                or max(jsonl_ts) < min(span_ts)):
-            offset_us = min(span_ts) - min(jsonl_ts)
+    # (align_offset is the same rule the FleetCollector applies per
+    # replica stream)
+    offset_us = align_offset(
+        (min(span_ts), max(span_ts)) if span_ts else None,
+        (min(jsonl_ts), max(jsonl_ts)) if jsonl_ts else None)
 
     mpid = max((e.get("pid", 0) for e in events
                 if isinstance(e.get("pid"), int)), default=0) + 1
