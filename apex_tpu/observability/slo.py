@@ -85,6 +85,10 @@ class RollingPercentiles:
         counts = self._current()
         counts[bisect.bisect_left(self.buckets, float(value))] += 1
 
+    def reset(self) -> None:
+        """Drop every live slot — the window restarts empty."""
+        self._ring.clear()
+
     def _merged(self) -> list:
         self._expire(int(self.clock() // self.slot_s))
         merged = [0] * (len(self.buckets) + 1)
@@ -176,6 +180,10 @@ class _WindowedCounts:
         slot[1] += bool(good)
         slot[2] += 1
 
+    def reset(self) -> None:
+        """Drop every live slot — the window restarts empty."""
+        self._ring.clear()
+
     def rates(self, window_s: float) -> Tuple[int, int]:
         """(bad, total) over the trailing ``window_s`` seconds."""
         idx = int(self.clock() // self.slot_s)
@@ -230,6 +238,10 @@ class SLOMonitor:
                  if self.burn_windows else 1.0)
         self._counts = {t.name: _WindowedCounts(slot_s, max_w, clock)
                         for t in self.targets}
+        # window epoch: bumped by reset_windows() at capacity-change
+        # boundaries so burn is never computed across a shift
+        self.epoch = 0
+        self.epoch_tag: Optional[str] = None
         self._c_events = self._g_burn = None
         if registry is not None:
             self._c_events = registry.counter(
@@ -244,6 +256,36 @@ class SLOMonitor:
             self._g_quant = registry.gauge(
                 "slo_latency_quantile", "rolling-window quantile",
                 labelnames=("metric", "quantile"))
+            self._g_epoch = registry.gauge(
+                "slo_window_epoch",
+                "burn/percentile window epoch (bumped on reset_windows)")
+            self._g_epoch.set(0)
+
+    # -- window epochs -------------------------------------------------------
+
+    def reset_windows(self, epoch: Optional[str] = None) -> None:
+        """Forget every rolling window (burn counts AND percentile
+        slots) and bump the window epoch.
+
+        The capacity controller calls this when the capacity split
+        changes: burn computed over a pre-shift window describes a
+        fleet that no longer exists, and acting on it immediately
+        re-triggers the next shift — the stale-burn flapping bug.
+        After a reset, burn is 0.0 until post-shift traffic refills the
+        windows.  ``epoch`` is an optional tag (e.g. ``"shift-3"``)
+        surfaced as :attr:`epoch_tag`; :attr:`epoch` is a monotonic
+        counter exported as the ``slo_window_epoch`` gauge.
+        """
+        for c in self._counts.values():
+            c.reset()
+        for p in self._pcts.values():
+            p.reset()
+        self.epoch += 1
+        self.epoch_tag = epoch
+        if self.registry is not None:
+            self._g_epoch.set(self.epoch)
+            self.registry.event("slo_window_reset", epoch=self.epoch,
+                                tag=epoch)
 
     # -- ingestion -----------------------------------------------------------
 

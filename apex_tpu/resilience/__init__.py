@@ -22,8 +22,18 @@ Four layers, composable but independently usable:
   and per-leaf slot re-layout across dp/tp/pp changes, f32 bitwise),
   and :class:`ElasticTrainer`, the signal-driven drain → checkpoint →
   re-plan → re-shard → resume loop around :class:`GuardedTrainStep`.
+* :mod:`~apex_tpu.resilience.capacity` — the train+serve capacity
+  loop: :class:`CapacityController` shifts chips between an
+  :class:`ElasticTrainer` and a serving fleet on SLO burn, with
+  hysteresis + cooldown, a two-phase shift protocol with rollback, and
+  ``capacity_change`` fault injection (proven by
+  ``tools/day_in_life.py``).
 """
 
+from apex_tpu.resilience.capacity import (CAPACITY_FAULT_MODES,
+                                          CapacityBudget,
+                                          CapacityController,
+                                          ReshardFailed, fault_mode)
 from apex_tpu.resilience.checkpoint import (CheckpointManager,
                                             CheckpointNotFound)
 from apex_tpu.resilience.elastic import (ElasticComponents, ElasticPlan,
@@ -37,6 +47,11 @@ from apex_tpu.resilience.guard import (GuardedTrainStep, GuardState,
                                        StepResult)
 
 __all__ = [
+    "CAPACITY_FAULT_MODES",
+    "CapacityBudget",
+    "CapacityController",
+    "ReshardFailed",
+    "fault_mode",
     "CheckpointManager",
     "CheckpointNotFound",
     "ElasticComponents",

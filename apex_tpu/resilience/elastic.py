@@ -560,6 +560,7 @@ class ElasticTrainer:
         self._comp: Optional[ElasticComponents] = None
         self._params = self._opt = self._gstate = self._sstate = None
         self._preempt_requested = False
+        self._step = 0
         self.stats = {"replans": 0, "preempt_signals": 0,
                       "resume_step": 0, "last_checkpoint_s": 0.0,
                       "last_reshard_s": 0.0}
@@ -717,8 +718,18 @@ class ElasticTrainer:
             new_plan = ElasticPlan.build(new_spec, devices=self._devices)
             self.checkpoint.topology = new_plan.spec
             self.checkpoint.parallel_plan = new_plan.parallel
-            new_comp = self._build(new_plan)
-            self._reshard_onto(old_plan, old_comp, new_plan, new_comp)
+            try:
+                new_comp = self._build(new_plan)
+                self._reshard_onto(old_plan, old_comp, new_plan,
+                                   new_comp)
+            except Exception:
+                # a failed build/re-shard must leave the manifest
+                # stamped with the topology the live state still has —
+                # otherwise the next save (or a crash-restart restore)
+                # would claim a layout that never materialized
+                self.checkpoint.topology = old_plan.spec
+                self.checkpoint.parallel_plan = old_plan.parallel
+                raise
             self._comp, self.plan = new_comp, new_plan
             # post-reshard checkpoint in the NEW layout: the guard's
             # K-anomaly rollback must never restore an old-topology
@@ -774,6 +785,71 @@ class ElasticTrainer:
 
     # -- the loop ------------------------------------------------------------
 
+    def start(self, resume: bool = True) -> int:
+        """Build — or restore, with ``resume`` and a checkpoint present
+        — the live components.  Idempotent: once the trainer is live
+        this is a no-op, so external drivers (the capacity controller,
+        :meth:`step_once` callers) can call it freely.  Returns the
+        current step."""
+        if self._comp is None:
+            self._step = self._restore_or_init(resume)
+        return self._step
+
+    @property
+    def current_step(self) -> int:
+        """The step the next :meth:`step_once` will run."""
+        return self._step
+
+    def replan_to(self, new_spec, *, checkpoint_first: bool = True) -> None:
+        """Synchronous externally-driven re-plan to ``new_spec``
+        (:class:`TopologySpec` or ``ParallelPlan``) at the current step
+        boundary — the capacity controller's drain-training primitive.
+        The boundary checkpoint inside :meth:`_replan` IS the drain;
+        failures propagate so the caller can roll back (the checkpoint
+        stamp is already restored by then)."""
+        self.start()
+        self._replan(new_spec, self._step,
+                     checkpoint_first=checkpoint_first)
+
+    def step_once(self, batch_fn) -> str:
+        """Advance exactly one guarded step (after signal polling).
+        Returns ``"ran"``, or ``"preempted"`` when a preempt signal
+        checkpointed and stopped the trainer instead.  This is
+        :meth:`train`'s loop body exposed so an external driver can
+        interleave training steps with fleet ticks."""
+        self.start()
+        step = self._step
+        target = self._poll_signals(step)
+        if self._preempt_requested:
+            self.checkpoint.wait()
+            self._save(step)
+            self._preempt_requested = False
+            return "preempted"
+        if target is not None:
+            # a target equal to the current spec is an IN-PLACE
+            # rebuild (checkpoint, recompile, identity re-partition)
+            # — the device-swap case where counts survive but the
+            # hardware underneath changed
+            self._replan(target, step)
+        comp = self._comp
+        res = comp.guard(self._params, self._opt, self._gstate,
+                         *batch_fn(step, self.plan),
+                         scaler_state=self._sstate, step=step)
+        self._params, self._opt = res.params, res.opt_state
+        self._gstate, self._sstate = res.guard_state, res.scaler_state
+        step = res.next_step
+        if self.recorder is not None:
+            self.recorder.record("trainer", "step", step=step,
+                                 loss=float(res.loss_value),
+                                 rolled_back=bool(res.rolled_back))
+            if res.rolled_back:
+                self.recorder.trigger("guard_rollback", step=step,
+                                      loss=float(res.loss_value))
+        if step % self.save_every == 0 or res.rolled_back:
+            self._save(step)
+        self._step = step
+        return "ran"
+
     def train(self, batch_fn, n_steps: int, *, resume: bool = True) -> dict:
         """Run up to ``n_steps`` guarded steps, reacting to signals.
 
@@ -786,40 +862,14 @@ class ElasticTrainer:
         semantics are a fresh trainer with ``resume=True`` (the
         default), which restores the stamped topology and re-shards.
         """
-        step = self._restore_or_init(resume)
+        self.start(resume)
         status = "completed"
-        while step < n_steps:
-            target = self._poll_signals(step)
-            if self._preempt_requested:
-                self.checkpoint.wait()
-                self._save(step)
-                self._preempt_requested = False
+        while self._step < n_steps:
+            if self.step_once(batch_fn) == "preempted":
                 status = "preempted"
                 break
-            if target is not None:
-                # a target equal to the current spec is an IN-PLACE
-                # rebuild (checkpoint, recompile, identity re-partition)
-                # — the device-swap case where counts survive but the
-                # hardware underneath changed
-                self._replan(target, step)
-            comp = self._comp
-            res = comp.guard(self._params, self._opt, self._gstate,
-                             *batch_fn(step, self.plan),
-                             scaler_state=self._sstate, step=step)
-            self._params, self._opt = res.params, res.opt_state
-            self._gstate, self._sstate = res.guard_state, res.scaler_state
-            step = res.next_step
-            if self.recorder is not None:
-                self.recorder.record("trainer", "step", step=step,
-                                     loss=float(res.loss_value),
-                                     rolled_back=bool(res.rolled_back))
-                if res.rolled_back:
-                    self.recorder.trigger("guard_rollback", step=step,
-                                          loss=float(res.loss_value))
-            if step % self.save_every == 0 or res.rolled_back:
-                self._save(step)
-        self._final_step = step
-        return {"status": status, "step": step,
+        self._final_step = self._step
+        return {"status": status, "step": self._step,
                 "replans": self.stats["replans"],
                 "preempt_signals": self.stats["preempt_signals"],
                 "rollbacks": (self._comp.guard.counters["rollbacks"]

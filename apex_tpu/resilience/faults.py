@@ -20,7 +20,18 @@ same failure sequence on every run.  Kinds:
                     names the new dp degree, 0 asks the elastic trainer to
                     toggle shrink-to-half / grow-back; consumed via
                     :meth:`FaultInjector.check_topology_change`
+``capacity_change`` a train<->serve capacity shift in flight at that step
+                    fails: ``magnitude`` selects the failure mode (0/1
+                    mid-shift crash, 2 stuck drain, 3 failed re-shard — see
+                    ``apex_tpu.resilience.capacity.fault_mode``); consumed
+                    via :meth:`FaultInjector.check_capacity_change` by the
+                    :class:`~apex_tpu.resilience.capacity.CapacityController`
 =================== =========================================================
+
+``capacity_change`` is appended LAST so :meth:`FaultInjector.from_seed`
+schedules for the pre-existing kinds are byte-identical to before it
+existed — ``seeded_schedule`` consumes no rng state for rate-0 kinds
+(asserted by ``tests/test_capacity.py``).
 
 The in-jit kinds are injected as DATA, not control flow:
 :meth:`grad_flags` returns three scalars the guarded train step folds in
@@ -37,7 +48,8 @@ from typing import Dict, Iterable, List, Optional, Tuple
 import numpy as np
 
 FAULT_KINDS = ("nan_grads", "inf_loss", "grad_spike", "preempt_at_step",
-               "corrupt_checkpoint", "slow_host", "topology_change")
+               "corrupt_checkpoint", "slow_host", "topology_change",
+               "capacity_change")
 
 # the serving-side fault kinds live in apex_tpu.serving.fleet
 # (SERVING_FAULT_KINDS); its ServingFaultInjector generates schedules
@@ -76,11 +88,21 @@ class Preemption(RuntimeError):
 @dataclasses.dataclass(frozen=True)
 class Fault:
     """One scheduled fault.  ``magnitude`` is the spike factor for
-    ``grad_spike``, the sleep seconds for ``slow_host``, and the target
-    dp degree for ``topology_change`` (0 = auto shrink/grow toggle)."""
+    ``grad_spike``, the sleep seconds for ``slow_host``, the target
+    dp degree for ``topology_change`` (0 = auto shrink/grow toggle),
+    and the failure mode for ``capacity_change``.
+
+    ``once=True`` makes the fault fire a single time: it is removed
+    from the schedule when consumed, so steps RE-RUN after a guard
+    rollback execute clean — the model of a state-dependent anomaly
+    (loss blowup) that the rollback actually cures.  A step-keyed fault
+    that re-fires forever would pin a K-consecutive-anomaly rollback in
+    a restore/re-fire loop; ``once`` is what lets the day-in-the-life
+    sim exercise a rollback that terminates."""
     step: int
     kind: str
     magnitude: float = 0.0
+    once: bool = False
 
     def __post_init__(self):
         if self.kind not in FAULT_KINDS:
@@ -131,6 +153,17 @@ class FaultInjector:
                 return f
         return None
 
+    def _consume(self, step: int, kind: str) -> Optional[Fault]:
+        """Find + record; ``once`` faults leave the schedule so a
+        rolled-back re-run of the same step executes clean."""
+        f = self._find(step, kind)
+        if f is None:
+            return None
+        self.record(step, kind)
+        if f.once:
+            self._by_step[step].remove(f)
+        return f
+
     def record(self, step: int, kind: str) -> None:
         """Append to the applied-fault log (callers record at the point
         the fault actually lands, so the log is the ground truth tests
@@ -146,21 +179,17 @@ class FaultInjector:
         guarded step with ``jnp.where``; see
         :class:`~apex_tpu.resilience.guard.GuardedTrainStep`."""
         out = {"nan_grads": 0.0, "inf_loss": 0.0, "spike_scale": 1.0}
-        if self._find(step, "nan_grads"):
+        if self._consume(step, "nan_grads"):
             out["nan_grads"] = 1.0
-            self.record(step, "nan_grads")
-        if self._find(step, "inf_loss"):
+        if self._consume(step, "inf_loss"):
             out["inf_loss"] = 1.0
-            self.record(step, "inf_loss")
-        spike = self._find(step, "grad_spike")
+        spike = self._consume(step, "grad_spike")
         if spike:
             out["spike_scale"] = float(spike.magnitude or 64.0)
-            self.record(step, "grad_spike")
         return out
 
     def check_preempt(self, step: int) -> None:
-        if self._find(step, "preempt_at_step"):
-            self.record(step, "preempt_at_step")
+        if self._consume(step, "preempt_at_step"):
             raise Preemption(step)
 
     def check_topology_change(self, step: int) -> Optional[Fault]:
@@ -171,6 +200,18 @@ class FaultInjector:
         f = self._find(step, "topology_change")
         if f is not None:
             self.record(step, "topology_change")
+        return f
+
+    def check_capacity_change(self, step: int) -> Optional[Fault]:
+        """The scheduled ``capacity_change`` at ``step``, if any —
+        consumed (recorded + removed) so one scheduled fault fails one
+        shift: the capacity controller's retry after the rollback must
+        be able to succeed.  ``magnitude`` selects the failure mode;
+        see ``apex_tpu.resilience.capacity.fault_mode``."""
+        f = self._find(step, "capacity_change")
+        if f is not None:
+            self.record(step, "capacity_change")
+            self._by_step[step].remove(f)
         return f
 
     def maybe_slow_host(self, step: int) -> None:

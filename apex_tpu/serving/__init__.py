@@ -15,8 +15,10 @@ What :mod:`apex_tpu.inference` leaves on the table, this package takes:
 * :mod:`apex_tpu.serving.fleet` — fault tolerance: deterministic
   replica fault injection (:class:`ServingFaultInjector`), the
   health-checked :class:`FleetRouter` (retry/backoff, hedging,
-  cross-replica migration with token-bitwise resume), and the
-  burn-driven :class:`DegradationLadder`.
+  cross-replica migration with token-bitwise resume, and the
+  drain/add/remove replica lifecycle the capacity controller in
+  :mod:`apex_tpu.resilience.capacity` drives), and the burn-driven
+  :class:`DegradationLadder`.
 
 ``tools/loadgen.py`` drives the stack under heavy-tail open-loop
 traffic (and, with ``--scenario``, under chaos workloads) and reports

@@ -52,9 +52,19 @@ Four pieces, each the serving analogue of a training-resilience part:
   ``serving_degraded_level`` gauge; transitions land on the Perfetto
   timeline as instants.
 
+The fleet also exposes the **capacity lifecycle** the
+:class:`~apex_tpu.resilience.capacity.CapacityController` drives:
+:meth:`FleetRouter.begin_drain` puts a replica in the DRAINING state
+(no new placements, work migrated off via the same export/adopt
+machinery, never marked dead), :meth:`FleetRouter.remove_replica`
+detaches a drained replica leaving a ``None`` tombstone in its slot
+(indices stay stable), and :meth:`FleetRouter.add_replica` attaches a
+fresh engine, reusing tombstone slots.  :meth:`FleetRouter.cancel_drain`
+is the shift-rollback path.
+
 Fleet series: ``serving_retries_total`` / ``serving_hedges_total`` /
 ``serving_migrations_total`` counters, ``serving_replica_health``
-(0 healthy, 1 suspect, 2 dead, 3 recovering) and
+(0 healthy, 1 suspect, 2 dead, 3 recovering, 4 draining, 5 removed) and
 ``serving_degraded_level`` gauges.  ``tools/loadgen.py --scenario``
 drives the whole thing under chaos workloads (replica-kill mid-burst,
 slow replica, diurnal, bursty overload) asserting SLO attainment and
@@ -76,7 +86,8 @@ from apex_tpu.resilience.faults import seeded_schedule
 from apex_tpu.serving.router import RequestShed, Router, ShedReason
 
 SERVING_FAULT_KINDS = ("replica_crash", "stuck_decode", "slow_replica",
-                       "kv_pool_exhaustion", "reject_admission")
+                       "kv_pool_exhaustion", "reject_admission",
+                       "capacity_change")
 
 
 class VirtualClock:
@@ -99,7 +110,13 @@ class VirtualClock:
 class ServingFault:
     """One scheduled replica fault, active for ``duration`` fleet ticks
     starting at ``tick``.  ``magnitude`` is the injected extra seconds
-    per tick for ``slow_replica`` (unused otherwise)."""
+    per tick for ``slow_replica`` and the failure mode for
+    ``capacity_change`` (0/1 mid-shift crash, 2 stuck drain, 3 failed
+    re-shard — see ``apex_tpu.resilience.capacity.fault_mode``; that
+    kind is fleet-scoped and consumed by the
+    :class:`~apex_tpu.resilience.capacity.CapacityController` via
+    :meth:`ServingFaultInjector.capacity_change_at`, not applied by the
+    fleet tick loop)."""
     tick: int
     replica: int
     kind: str
@@ -157,7 +174,8 @@ class ServingFaultInjector:
         dur = {"replica_crash": crash_ticks, "stuck_decode": stuck_ticks,
                "slow_replica": slow_ticks,
                "kv_pool_exhaustion": pressure_ticks,
-               "reject_admission": pressure_ticks}
+               "reject_admission": pressure_ticks,
+               "capacity_change": 1}
         faults = [
             ServingFault(tick, rep, kind,
                          magnitude=slow_s if kind == "slow_replica" else 0.0,
@@ -173,25 +191,56 @@ class ServingFaultInjector:
 
     def activate(self, tick: int, replica: int) -> Tuple[ServingFault, ...]:
         """Active faults, recording each into the applied log the first
-        tick the fleet actually applies it."""
+        tick the fleet actually applies it.  ``capacity_change`` is
+        never recorded here — the fleet tick loop does not apply it;
+        the capacity controller consumes it via
+        :meth:`capacity_change_at`."""
         out = self.faults_at(tick, replica)
         for f in out:
+            if f.kind == "capacity_change":
+                continue
             if f not in self._recorded:
                 self._recorded.add(f)
                 self.log.append((int(tick), int(replica), f.kind))
         return out
 
+    def capacity_change_at(self, tick: int) -> Optional[ServingFault]:
+        """The first unconsumed ``capacity_change`` fault active at
+        ``tick``, across ALL replicas — a capacity shift is fleet-
+        scoped, so the replica field only disambiguates schedules.
+        Consume-once: the fault is recorded into the applied log and
+        never returned again, so one scheduled fault fails exactly one
+        shift and the controller's post-rollback retry can succeed."""
+        for f in self.schedule:
+            if f.kind != "capacity_change" or f in self._recorded:
+                continue
+            if f.tick <= tick < f.tick + f.duration:
+                self._recorded.add(f)
+                self.log.append((int(tick), int(f.replica), f.kind))
+                return f
+        return None
+
 
 class ReplicaHealth(enum.Enum):
-    """Per-replica health states; the gauge exports the index below."""
+    """Per-replica health states; the gauge exports the index below.
+
+    ``DRAINING`` is the capacity-shift state: the replica still serves
+    (and heartbeats) while its work migrates off, takes no new
+    placements, and is NEVER marked dead — a drain is an orderly exit,
+    not a failure, and declaring it dead would double-migrate the work
+    the drain already moved.  ``REMOVED`` is terminal: the slot holds a
+    ``None`` tombstone so every index-keyed structure stays valid."""
     HEALTHY = "healthy"
     SUSPECT = "suspect"
     DEAD = "dead"
     RECOVERING = "recovering"
+    DRAINING = "draining"
+    REMOVED = "removed"
 
 
 HEALTH_INDEX = {ReplicaHealth.HEALTHY: 0, ReplicaHealth.SUSPECT: 1,
-                ReplicaHealth.DEAD: 2, ReplicaHealth.RECOVERING: 3}
+                ReplicaHealth.DEAD: 2, ReplicaHealth.RECOVERING: 3,
+                ReplicaHealth.DRAINING: 4, ReplicaHealth.REMOVED: 5}
 
 
 class DegradationLadder:
@@ -349,7 +398,8 @@ class FleetRouter(Router):
             "in-flight requests migrated off a dead replica")
         self._g_health = r.gauge(
             "serving_replica_health",
-            "replica health (0 healthy, 1 suspect, 2 dead, 3 recovering)",
+            "replica health (0 healthy, 1 suspect, 2 dead, 3 recovering, "
+            "4 draining, 5 removed)",
             labelnames=("replica",))
         self._g_degraded = r.gauge(
             "serving_degraded_level",
@@ -398,6 +448,11 @@ class FleetRouter(Router):
 
     def _miss(self, i: int) -> None:
         st = self._state[i]
+        if st.health is ReplicaHealth.DRAINING:
+            # never dead while draining: the drain already migrated the
+            # work off; a death verdict would migrate it a second time
+            st.misses += 1
+            return
         st.ok_streak = 0
         st.misses += 1
         if st.health is ReplicaHealth.RECOVERING:
@@ -411,6 +466,9 @@ class FleetRouter(Router):
 
     def _beat(self, i: int) -> None:
         st = self._state[i]
+        if st.health is ReplicaHealth.DRAINING:
+            st.misses = 0        # sticky: only the lifecycle exits it
+            return
         st.misses = 0
         if st.health is ReplicaHealth.SUSPECT and not st.slow:
             self._transition(i, ReplicaHealth.HEALTHY)
@@ -459,9 +517,29 @@ class FleetRouter(Router):
         return super()._eligible(i, eng, burn)
 
     def _ctx_cap(self) -> int:
-        max_seq = min(getattr(e, "max_seq", 1 << 30)
-                      for e in self.replicas)
+        max_seq = min((getattr(e, "max_seq", 1 << 30)
+                       for _, e in self._live()), default=1 << 30)
         return int(max_seq * self.ladder.ctx_cap_frac)
+
+    def _fleet_trace(self):
+        """Any live replica's trace lane for router-level marks
+        (retry/degrade) — replica 0 may be a tombstone after a
+        capacity removal."""
+        for _, e in self._live():
+            return e.trace
+        return None
+
+    def _drain_retry_hint(self) -> float:
+        """Depth-scaled Retry-After for DRAINING sheds: proportional
+        to the remaining work on the least-loaded draining replica, so
+        the client returns roughly when the drain completes and fresh
+        placements (or re-admission after rollback) are possible."""
+        loads = [e.queue_depth + e.active_requests
+                 for i, e in self._live()
+                 if self._state[i].health is ReplicaHealth.DRAINING]
+        if not loads:
+            return self._retry_after_hint()
+        return 0.05 * (1.0 + min(loads) / max(self.max_queue_depth, 1))
 
     def submit(self, request: Request) -> int:
         now = self.clock()
@@ -493,14 +571,22 @@ class FleetRouter(Router):
             self._c_shed.inc()
             healthy = any(s.health is ReplicaHealth.HEALTHY
                           for s in self._state)
-            self._flow_shed(request,
-                            ShedReason.OVERLOAD if healthy
-                            else ShedReason.NO_HEALTHY_REPLICA)
-            raise RequestShed(
-                "no eligible replica",
-                reason=(ShedReason.OVERLOAD if healthy
-                        else ShedReason.NO_HEALTHY_REPLICA),
-                retry_after_s=self._retry_after_hint())
+            draining = any(s.health is ReplicaHealth.DRAINING
+                           for s in self._state)
+            if healthy:
+                reason, hint = ShedReason.OVERLOAD, \
+                    self._retry_after_hint()
+            elif draining:
+                # capacity shift in progress: tell the client WHEN the
+                # drain should be over, not just that it was refused
+                reason, hint = ShedReason.DRAINING, \
+                    self._drain_retry_hint()
+            else:
+                reason, hint = ShedReason.NO_HEALTHY_REPLICA, \
+                    self._retry_after_hint()
+            self._flow_shed(request, reason)
+            raise RequestShed("no eligible replica", reason=reason,
+                              retry_after_s=hint)
         self._inflight[request.request_id] = _InFlight(request, i, now)
         if self.recorder is not None:
             self.recorder.record("router", "place",
@@ -523,7 +609,7 @@ class FleetRouter(Router):
         health-gated only; the overload gate does not apply to work the
         fleet already accepted."""
         best, best_load = None, None
-        for i, eng in enumerate(self.replicas):
+        for i, eng in self._live():
             if i == exclude \
                     or self._state[i].health is not ReplicaHealth.HEALTHY:
                 continue
@@ -532,9 +618,114 @@ class FleetRouter(Router):
                 best, best_load = i, load
         return best
 
+    # -- capacity lifecycle --------------------------------------------------
+
+    def begin_drain(self, i: int) -> None:
+        """Start an orderly drain of replica ``i`` for a capacity
+        shift: it stops taking placements (DRAINING is never eligible),
+        its queued + in-flight work migrates to healthy peers NOW via
+        the same export/adopt machinery a death uses (token-bitwise
+        resume), and the heartbeat machine will never mark it dead —
+        see :class:`ReplicaHealth`.  Idempotent while draining."""
+        if self.replicas[i] is None:
+            raise ValueError(f"replica {i} was removed")
+        st = self._state[i]
+        if st.health is ReplicaHealth.DRAINING:
+            return
+        if st.health is ReplicaHealth.DEAD:
+            raise ValueError(
+                f"replica {i} is dead; drain is for live exits")
+        st.slow = False
+        st.misses = 0
+        st.slow_streak = 0
+        self._transition(i, ReplicaHealth.DRAINING)
+        self._drain_from(i)
+        self._set_health_gauges()
+
+    def cancel_drain(self, i: int) -> None:
+        """Shift-rollback path: a draining replica returns to HEALTHY.
+        Work already migrated off stays where it landed — migration is
+        exactly-once, and pulling it back would risk duplication."""
+        st = self._state[i]
+        if self.replicas[i] is not None \
+                and st.health is ReplicaHealth.DRAINING:
+            st.misses = 0
+            st.ok_streak = 0
+            self._transition(i, ReplicaHealth.HEALTHY)
+            self._set_health_gauges()
+
+    def drained(self, i: int) -> bool:
+        """True when nothing is left on replica ``i``: empty engine
+        queue + active set, and no in-flight entry (primary or hedge)
+        still pointing at it."""
+        eng = self.replicas[i]
+        if eng is None:
+            return True
+        if eng._queue or eng._active:
+            return False
+        return not any(fl.replica == i or fl.hedge_replica == i
+                       for fl in self._inflight.values())
+
+    def remove_replica(self, i: int):
+        """Detach replica ``i`` and return its engine (the capacity
+        controller keeps it for rollback re-add).  The slot becomes a
+        ``None`` tombstone so indices in ``_state`` / ``_consumed`` /
+        in-flight records stay valid; finished responses are harvested
+        first and any straggler work is exported to peers."""
+        eng = self.replicas[i]
+        if eng is None:
+            raise ValueError(f"replica {i} already removed")
+        self._collect()
+        self._drain_from(i)
+        self._transition(i, ReplicaHealth.REMOVED)
+        self.replicas[i] = None
+        self._set_health_gauges()
+        if self.recorder is not None:
+            self.recorder.record("router", "remove_replica", replica=i,
+                                 tick=self._tick)
+        return eng
+
+    def add_replica(self, engine) -> int:
+        """Attach ``engine`` as a serving replica, reusing the first
+        tombstone slot (else appending); returns its index.  Responses
+        already inside the engine's done list count as consumed — an
+        engine re-added on rollback must not re-deliver them
+        (exactly-once)."""
+        slot = next((j for j, e in enumerate(self.replicas)
+                     if e is None), None)
+        if slot is None:
+            slot = len(self.replicas)
+            self.replicas.append(engine)
+            self._state.append(_ReplicaState())
+            self._consumed.append(len(engine._done))
+            self.health_log.append((self._tick, slot, "absent",
+                                    "healthy"))
+            self._c_trans.inc(**{"from": "absent", "to": "healthy"})
+        else:
+            self.replicas[slot] = engine
+            self._state[slot] = _ReplicaState()
+            self._consumed[slot] = len(engine._done)
+            self.health_log.append((self._tick, slot, "removed",
+                                    "healthy"))
+            self._c_trans.inc(**{"from": "removed", "to": "healthy"})
+        self._tracing = self._tracing or (
+            getattr(getattr(engine, "trace", None), "tracer", None)
+            is not None)
+        self._set_health_gauges()
+        if self.recorder is not None:
+            self.recorder.record("router", "add_replica", replica=slot,
+                                 tick=self._tick)
+        return slot
+
     # -- migration -----------------------------------------------------------
 
     def _on_dead(self, i: int) -> None:
+        self._drain_from(i)
+
+    def _drain_from(self, i: int) -> None:
+        """Move replica ``i``'s queued + in-flight work to peers:
+        export with generated-so-far tokens, adopt elsewhere — the
+        resumed streams are token-bitwise the uninterrupted ones."""
         eng = self.replicas[i]
         now = self.clock()
         for req, progress in eng.export_inflight():
@@ -613,7 +804,7 @@ class FleetRouter(Router):
     # -- response collection -------------------------------------------------
 
     def _collect(self) -> None:
-        for i, eng in enumerate(self.replicas):
+        for i, eng in self._live():
             done = eng._done
             while self._consumed[i] < len(done):
                 resp = done[self._consumed[i]]
@@ -641,6 +832,9 @@ class FleetRouter(Router):
             return
         for rid, (rep, baseline) in list(self._resume_watch.items()):
             eng = self.replicas[rep]
+            if eng is None:
+                self._resume_watch.pop(rid, None)
+                continue
             for st in eng._active.values():
                 if st.request.request_id == rid \
                         and len(st.generated) > baseline:
@@ -659,7 +853,9 @@ class FleetRouter(Router):
             if fl.hedge_replica is not None \
                     or now - fl.submitted_t < self.hedge_after_s:
                 continue
-            if rid in self.replicas[fl.replica].metrics.ttft:
+            src_eng = self.replicas[fl.replica]
+            if src_eng is None \
+                    or rid in src_eng.metrics.ttft:
                 continue                     # already past the TTFT tail
             target = self._pick_target(exclude=fl.replica)
             if target is None:
@@ -691,7 +887,9 @@ class FleetRouter(Router):
                 continue
             self.retries += 1
             self._c_retries.inc()
-            self.replicas[0].trace.retry(rid, pr.attempts)
+            tr = self._fleet_trace()
+            if tr is not None:
+                tr.retry(rid, pr.attempts)
             if self.recorder is not None:
                 self.recorder.record("router", "retry", request_id=rid,
                                      attempt=pr.attempts,
@@ -719,24 +917,29 @@ class FleetRouter(Router):
     def _degrade_pass(self) -> None:
         if self.ladder is None:
             return
-        burn = max(self._burn(e) for e in self.replicas)
+        live = self._live()
+        if not live:
+            return
+        burn = max(self._burn(e) for _, e in live)
         old = self.ladder.level
         lvl = self.ladder.update(burn, self.clock())
         if lvl == old:
             return
         self._g_degraded.set(lvl)
-        self.replicas[0].trace.degrade(lvl)
+        tr = self._fleet_trace()
+        if tr is not None:
+            tr.degrade(lvl)
         if self.recorder is not None:
             self.recorder.record("router", "degrade", old=old, new=lvl,
                                  burn=burn, tick=self._tick)
             if lvl > old:
                 self.recorder.trigger("ladder_escalation", level=lvl,
                                       burn=burn, tick=self._tick)
-        for eng in self.replicas:
+        for _, eng in live:
             if getattr(eng, "spec", None) is not None:
                 eng.spec_enabled = lvl < 1
         if lvl >= 2 and old < 2:
-            for eng in self.replicas:
+            for _, eng in live:
                 pool = getattr(eng, "pool", None)
                 if pool is not None:
                     pool.flush_prefixes()
@@ -751,7 +954,7 @@ class FleetRouter(Router):
         t = self._tick
         busy = False
         durations: Dict[int, float] = {}
-        for i, eng in enumerate(self.replicas):
+        for i, eng in self._live():
             kinds: Dict[str, ServingFault] = {}
             if self.injector is not None:
                 kinds = {f.kind: f for f in self.injector.activate(t, i)}
@@ -816,7 +1019,7 @@ class FleetRouter(Router):
             busy = self.step()
             steps += 1
             if not busy and not any(e._queue or e._active
-                                    for e in self.replicas):
+                                    for _, e in self._live()):
                 break
             if max_steps is not None and steps >= max_steps:
                 break

@@ -47,6 +47,7 @@ class ShedReason(enum.Enum):
     CONTEXT_CAP = "context_cap"              # degradation L2 prompt cap
     DEGRADED = "degraded"                    # degradation L3: shed all
     RETRY_BUDGET_EXHAUSTED = "retry_budget_exhausted"
+    DRAINING = "draining"                    # fleet: capacity shift drain
 
 
 class RequestShed(RuntimeError):
@@ -111,6 +112,13 @@ class Router:
 
     # -- signals -------------------------------------------------------------
 
+    def _live(self):
+        """(index, engine) pairs skipping tombstones — a replica
+        removed by the fleet's capacity lifecycle leaves ``None`` in
+        its slot so every other index-keyed structure stays valid."""
+        return [(i, e) for i, e in enumerate(self.replicas)
+                if e is not None]
+
     def _burn(self, engine) -> float:
         """Max short-window burn across the replica's SLO targets (0.0
         when the replica has no SLO monitor attached)."""
@@ -135,7 +143,10 @@ class Router:
         request on the least-loaded replica — deeper backlog, longer
         hint, so backed-off clients return staggered, not in a thundering
         herd (the loadgen additionally jitters it)."""
-        depth = min(e.queue_depth for e in self.replicas)
+        live = self._live()
+        if not live:
+            return 0.05 * 2.0
+        depth = min(e.queue_depth for _, e in live)
         return 0.05 * (1.0 + depth / max(self.max_queue_depth, 1))
 
     # -- admission -----------------------------------------------------------
@@ -157,7 +168,7 @@ class Router:
         only the engines are traced)."""
         if self.tracer is not None:
             return self.tracer
-        for e in self.replicas:
+        for _, e in self._live():
             t = getattr(getattr(e, "trace", None), "tracer", None)
             if t is not None:
                 return t
@@ -177,7 +188,7 @@ class Router:
         replica's own bounded queue filling concurrently — just moves
         on to the next candidate)."""
         scored = []
-        for i, eng in enumerate(self.replicas):
+        for i, eng in self._live():
             burn = self._burn(eng)
             self._g_depth.set(eng.queue_depth, replica=str(i))
             self._g_burn.set(burn, replica=str(i))
@@ -217,7 +228,7 @@ class Router:
         """Advance every replica one engine tick; True while any has
         (or may have) work."""
         busy = False
-        for eng in self.replicas:
+        for _, eng in self._live():
             busy = eng.step() or busy
         return busy
 
@@ -225,7 +236,7 @@ class Router:
         """Drive :meth:`step` to drain (or ``max_steps``); returns all
         completed responses across replicas."""
         steps = 0
-        while any(e._queue or e._active for e in self.replicas):
+        while any(e._queue or e._active for _, e in self._live()):
             self.step()
             steps += 1
             if max_steps is not None and steps >= max_steps:
@@ -234,15 +245,15 @@ class Router:
 
     @property
     def queue_depth(self) -> int:
-        return sum(e.queue_depth for e in self.replicas)
+        return sum(e.queue_depth for _, e in self._live())
 
     @property
     def active_requests(self) -> int:
-        return sum(e.active_requests for e in self.replicas)
+        return sum(e.active_requests for _, e in self._live())
 
     @property
     def completed(self) -> List[Response]:
         out: List[Response] = []
-        for eng in self.replicas:
+        for _, eng in self._live():
             out.extend(eng.completed)
         return out

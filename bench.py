@@ -1190,6 +1190,116 @@ def bench_elastic():
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_capacity():
+    """Capacity-shifting leg (ROADMAP item 4): what moving chips
+    between training and serving costs.
+
+    A :class:`CapacityController` over a FusedAdam elastic trainer and
+    a two-replica paged fleet runs one full lease cycle — shift
+    **to_serving** (boundary-checkpoint drain + shrink re-shard +
+    replica start) then **to_training** (replica migration drain +
+    remove + grow re-shard) — and reports each shift's phase
+    decomposition from the controller's own stats: ``drain_s``,
+    ``reshard_s``, ``commit_s``, ``total_s`` (wall; the controller is
+    given a wall clock while the fleet stays on its virtual one), plus
+    the fleet ticks the serving drain took.  These are the latency
+    numbers an operator trades against the SLO burn a shift relieves."""
+    import shutil
+    import tempfile
+
+    from apex_tpu.models.gpt import GPTConfig, GPTModel
+    from apex_tpu.observability.slo import SLOMonitor, SLOTarget
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.resilience import (CapacityController, ElasticComponents,
+                                     ElasticPlan, ElasticTrainer,
+                                     GuardedTrainStep, TopologySpec)
+    from apex_tpu.serving import (FleetRouter, PagedInferenceEngine,
+                                  TickScheduler, VirtualClock)
+    from apex_tpu.utils.profiling import ServingMetrics
+
+    _free_calibration()
+    n = len(jax.devices())
+    if n < 2:
+        return {"skipped": "needs >= 2 devices"}
+    dp = 4 if n >= 4 else 2
+
+    def loss_fn(p, x, y):
+        return jnp.mean(jnp.square(x @ p["w"] + p["b"] - y))
+
+    def factory(plan, ckpt, inj):
+        opt = FusedAdam(lr=1e-3, bucketed=False)
+        guard = GuardedTrainStep(loss_fn, opt, warmup_steps=1,
+                                 checkpoint=ckpt, fault_injector=inj)
+        r = np.random.RandomState(7)
+        params = plan.put(
+            {"w": jnp.asarray((r.randn(512, 256) * 0.02).astype(np.float32)),
+             "b": jnp.zeros((256,), jnp.float32)})
+        return ElasticComponents(guard, params, opt.init(params),
+                                 guard.init_state())
+
+    def batch_fn(step, plan):
+        r = np.random.RandomState(9_000 + step)
+        return (jnp.asarray(r.randn(64, 512).astype(np.float32)),
+                jnp.asarray(r.randn(64, 256).astype(np.float32)))
+
+    clock = VirtualClock()
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                    num_attention_heads=2, max_seq_len=64)
+    model = GPTModel(cfg)
+    mparams = model.init_params(jax.random.PRNGKey(0))
+
+    def make_replica():
+        slo = SLOMonitor([SLOTarget("ttft", 0.1, objective=0.9)],
+                         clock=clock)
+        return PagedInferenceEngine(
+            model, mparams, max_slots=4, block_size=8,
+            scheduler=TickScheduler(token_budget=64),
+            metrics=ServingMetrics(clock, slo=slo), max_queue=32,
+            clock=clock)
+
+    fleet = FleetRouter([make_replica(), make_replica()], clock=clock)
+    root = tempfile.mkdtemp(prefix="apex_tpu_bench_capacity_")
+    try:
+        trainer = ElasticTrainer(
+            factory, ElasticPlan.build(TopologySpec(dp=dp)),
+            directory=root, save_every=1)
+        ctl = CapacityController(
+            trainer, fleet, make_replica, min_train_dp=max(1, dp // 2),
+            cooldown_s=0.0, clock=time.perf_counter)
+        for _ in range(3):            # compile + steady state
+            trainer.step_once(batch_fn)
+
+        ctl.request_shift("to_serving")
+        fleet.step()
+        ctl.tick()
+        clock.advance(0.01)
+        assert ctl.stats["shifts"] == 1, ctl.shift_log
+        to_serving = dict(ctl.stats["last_shift"])
+
+        trainer.step_once(batch_fn)   # absorb the shrunk-plan recompile
+
+        ctl.request_shift("to_training")
+        ticks = 0
+        while ctl.outstanding_leases or ctl.shifting:
+            fleet.step()
+            ctl.tick()
+            clock.advance(0.01)
+            ticks += 1
+            assert ticks < 200, "capacity drain did not converge"
+        assert ctl.stats["shifts"] == 2, ctl.shift_log
+        to_training = dict(ctl.stats["last_shift"])
+
+        rnd = lambda d: {k: (round(v, 5) if isinstance(v, float) else v)
+                         for k, v in d.items()}
+        return {"dp": dp, "shrink_dp": max(1, dp // 2),
+                "replicas_leased": (dp - max(1, dp // 2)),
+                "to_serving": rnd(to_serving),
+                "to_training": rnd(to_training),
+                "serving_drain_ticks": ticks}
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def bench_observability():
     """Observability leg (ISSUE 5): what monitoring costs.
 
@@ -1666,6 +1776,7 @@ def main():
     pp_schedules = _retry(bench_pp_schedules)
     resilience = _retry(bench_resilience)
     elastic = _retry(bench_elastic)
+    capacity = _retry(bench_capacity)
     observability = _retry(bench_observability)
     serving_obs = _retry(bench_serving_observability)
     serving_paged = _retry(bench_serving_paged)
@@ -1698,6 +1809,7 @@ def main():
             "pp_schedules": pp_schedules,
             "resilience": resilience,
             "elastic": elastic,
+            "capacity": capacity,
             "observability": rounded(observability),
             "serving_observability": rounded(serving_obs),
             "serving_paged": serving_paged,

@@ -407,6 +407,91 @@ class TestElasticTrainer:
         assert tr.stats["last_reshard_s"] > 0
 
 
+@needs8
+class TestSteppableAPI:
+    """The externally-driven surface the capacity controller consumes:
+    start/step_once/replan_to must compose to exactly what train()
+    does — same steps, same checkpoints, bitwise-same state."""
+
+    N = 5
+
+    def test_step_once_loop_matches_train_bitwise(self, tmp_path):
+        ref = ElasticTrainer(_factory, ElasticPlan.build(TopologySpec(dp=4)),
+                             directory=str(tmp_path / "ref"))
+        ref.train(_batch, self.N)
+        tr = ElasticTrainer(_factory, ElasticPlan.build(TopologySpec(dp=4)),
+                            directory=str(tmp_path / "a"))
+        assert tr.start() == 0
+        assert tr.start() == 0                   # idempotent no-op
+        while tr.current_step < self.N:
+            assert tr.step_once(_batch) == "ran"
+        assert tr.current_step == self.N
+        for a, b in zip(_flat(tr), _flat(ref)):
+            np.testing.assert_array_equal(a, b)
+
+    def test_external_replan_to_matches_injected_shrink_grow(self, tmp_path):
+        """Driving the SAME shrink->grow cycle through replan_to() as
+        an injected topology_change fault produces must land bitwise on
+        the uninterrupted reference — the two drain paths are one."""
+        ref = ElasticTrainer(_factory, ElasticPlan.build(TopologySpec(dp=4)),
+                             directory=str(tmp_path / "ref"))
+        ref.train(_batch, self.N)
+        tr = ElasticTrainer(_factory, ElasticPlan.build(TopologySpec(dp=4)),
+                            directory=str(tmp_path / "a"))
+        for step in range(self.N):
+            if step == 1:
+                tr.replan_to(TopologySpec(dp=2))
+                assert tr.plan.spec == TopologySpec(dp=2)
+            if step == 3:
+                tr.replan_to(TopologySpec(dp=4))
+            assert tr.step_once(_batch) == "ran"
+        assert tr.plan.spec == TopologySpec(dp=4)
+        assert tr.stats["last_reshard_s"] > 0
+        assert tr.stats["last_checkpoint_s"] > 0
+        for a, b in zip(_flat(tr), _flat(ref)):
+            np.testing.assert_array_equal(a, b)
+
+    def test_step_once_surfaces_preempt_then_resumes(self, tmp_path):
+        signals = HostSignals()
+        tr = ElasticTrainer(_factory, ElasticPlan.build(TopologySpec(dp=4)),
+                            directory=str(tmp_path / "a"), signals=signals)
+        assert tr.step_once(_batch) == "ran"
+        signals.request_preempt()
+        assert tr.step_once(_batch) == "preempted"
+        assert tr.current_step == 1              # drained at the boundary
+        # the day-in-the-life restart idiom: fresh trainer, same
+        # directory, resumes from the drain checkpoint and matches
+        ref = ElasticTrainer(_factory, ElasticPlan.build(TopologySpec(dp=4)),
+                             directory=str(tmp_path / "ref"))
+        ref.train(_batch, self.N)
+        tr2 = ElasticTrainer(_factory, ElasticPlan.build(TopologySpec(dp=4)),
+                             directory=str(tmp_path / "a"))
+        assert tr2.start() == 1
+        while tr2.current_step < self.N:
+            tr2.step_once(_batch)
+        for a, b in zip(_flat(tr2), _flat(ref)):
+            np.testing.assert_array_equal(a, b)
+
+    def test_failed_replan_restores_stamp_and_continues(self, tmp_path):
+        ref = ElasticTrainer(_factory, ElasticPlan.build(TopologySpec(dp=4)),
+                             directory=str(tmp_path / "ref"))
+        ref.train(_batch, self.N)
+        tr = ElasticTrainer(_factory, ElasticPlan.build(TopologySpec(dp=4)),
+                            directory=str(tmp_path / "a"))
+        tr.step_once(_batch)
+        with pytest.raises(ValueError, match="devices"):
+            tr.replan_to(TopologySpec(dp=16))    # only 8 devices exist
+        # the failure left the trainer consistent: stamp still dp=4,
+        # training continues and still lands bitwise on the reference
+        assert tr.plan.spec == TopologySpec(dp=4)
+        assert tr.checkpoint.topology_of(tr.current_step) == \
+            TopologySpec(dp=4).to_dict()
+        while tr.current_step < self.N:
+            assert tr.step_once(_batch) == "ran"
+        for a, b in zip(_flat(tr), _flat(ref)):
+            np.testing.assert_array_equal(a, b)
+
+
 # -- serving-engine preemption ------------------------------------------------
 
 class TestEnginePreempt:

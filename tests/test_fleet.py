@@ -461,3 +461,125 @@ class TestScenarios:
         a = self._loadgen().run_scenario(_scenario_ns())
         b = self._loadgen().run_scenario(_scenario_ns())
         assert a == b                           # virtual clock: bitwise
+
+
+# -- capacity lifecycle (drain / remove / add) -------------------------------
+
+class TestCapacityLifecycle:
+    def test_begin_drain_migrates_token_bitwise(self, tiny):
+        """Drain a replica mid-decode: its work migrates NOW and every
+        stream still matches the uninterrupted single-engine run."""
+        model, params = tiny
+        reqs = _mixed_requests()
+        ref = _engine(model, params, False, VirtualClock())
+        for r in reqs:
+            ref.submit(_clone(r))
+        want = {r.request_id: (r.tokens, r.finish_reason)
+                for r in ref.run()}
+
+        fleet, replicas, _ = _fleet(model, params)
+        for r in reqs:
+            fleet.submit(_clone(r))
+        fleet.step()
+        fleet.begin_drain(0)
+        assert fleet.health(0) is ReplicaHealth.DRAINING
+        assert fleet.migrations > 0             # live work moved off
+        out = {r.request_id: (r.tokens, r.finish_reason)
+               for r in fleet.run(max_steps=200)}
+        assert out == want
+        assert fleet.drained(0)
+        assert fleet.duplicate_responses == 0 and fleet.pending == 0
+
+    def test_draining_is_never_marked_dead(self, tiny):
+        """A crash fault landing on a DRAINING replica must not produce
+        a death verdict — that would migrate the work a second time."""
+        model, params = tiny
+        inj = ServingFaultInjector([
+            ServingFault(2, 0, "replica_crash", duration=100)])
+        fleet, _, _ = _fleet(model, params, injector=inj)
+        fleet.step()
+        fleet.begin_drain(0)
+        fleet.begin_drain(0)                    # idempotent
+        for _ in range(10):
+            fleet.step()
+        assert fleet.health(0) is ReplicaHealth.DRAINING
+        states = {b for _, r, _, b in fleet.health_log if r == 0}
+        assert states == {"draining"}           # one transition, no dead
+
+    def test_draining_excluded_from_placement(self, tiny):
+        model, params = tiny
+        fleet, replicas, _ = _fleet(model, params)
+        fleet.begin_drain(0)
+        for i in range(4):
+            assert fleet.submit(Request(i, [1, 2, 3],
+                                        max_new_tokens=2)) == 1
+        assert replicas[0].queue_depth + replicas[0].active_requests == 0
+
+    def test_cancel_drain_restores_healthy(self, tiny):
+        model, params = tiny
+        fleet, _, _ = _fleet(model, params)
+        fleet.begin_drain(1)
+        fleet.cancel_drain(1)
+        assert fleet.health(1) is ReplicaHealth.HEALTHY
+        assert [(r, a, b) for _, r, a, b in fleet.health_log] == [
+            (1, "healthy", "draining"), (1, "draining", "healthy")]
+        # back in the placement rotation
+        assert fleet.submit(Request(9, [1, 2], max_new_tokens=2)) in (0, 1)
+
+    def test_drain_on_dead_or_removed_raises(self, tiny):
+        model, params = tiny
+        inj = ServingFaultInjector([
+            ServingFault(1, 0, "replica_crash", duration=10 ** 6)])
+        fleet, _, _ = _fleet(model, params, injector=inj)
+        fleet.step()
+        fleet.step()                            # replica 0 now DEAD
+        with pytest.raises(ValueError, match="dead"):
+            fleet.begin_drain(0)
+        fleet.remove_replica(1)
+        with pytest.raises(ValueError, match="removed"):
+            fleet.begin_drain(1)
+
+    def test_drained_semantics(self, tiny):
+        model, params = tiny
+        fleet, replicas, _ = _fleet(model, params)
+        assert fleet.drained(0) and fleet.drained(1)
+        i = fleet.submit(Request(0, [1, 2, 3], max_new_tokens=3))
+        assert not fleet.drained(i)             # in-flight entry points at i
+        list(fleet.run(max_steps=50))
+        assert fleet.drained(i)
+        fleet.remove_replica(0)
+        assert fleet.drained(0)                 # tombstone is trivially dry
+
+    def test_remove_add_reuses_tombstone_exactly_once(self, tiny):
+        model, params = tiny
+        fleet, replicas, _ = _fleet(model, params)
+        for i in range(3):
+            fleet.submit(Request(i, [1, 2, 3], max_new_tokens=3))
+        done = list(fleet.run(max_steps=100))
+        assert len(done) == 3
+        eng = fleet.remove_replica(1)
+        assert eng is replicas[1] and fleet.replicas[1] is None
+        assert [i for i, _ in fleet._live()] == [0]
+        # rollback path: the SAME engine comes back into its old slot;
+        # responses already harvested from it must not re-deliver
+        assert fleet.add_replica(eng) == 1
+        assert fleet.health(1) is ReplicaHealth.HEALTHY
+        fleet.submit(Request(7, [4, 5], max_new_tokens=2))
+        # completed is cumulative + deduplicated: the re-added engine's
+        # _done list still holds its earlier responses, but each id
+        # appears exactly once and nothing counts as a duplicate
+        out = list(fleet.run(max_steps=50))
+        assert sorted(r.request_id for r in out) == [0, 1, 2, 7]
+        assert fleet.duplicate_responses == 0
+        trans = [(r, a, b) for _, r, a, b in fleet.health_log]
+        assert (1, "healthy", "removed") in trans
+        assert (1, "removed", "healthy") in trans
+
+    def test_shed_reason_draining_with_depth_scaled_hint(self, tiny):
+        model, params = tiny
+        fleet, _, _ = _fleet(model, params, n=1, retry_budget=0)
+        fleet.begin_drain(0)
+        with pytest.raises(RequestShed) as ei:
+            fleet.submit(Request(0, [1, 2, 3]))
+        assert ei.value.reason is ShedReason.DRAINING
+        assert ei.value.retry_after_s > 0

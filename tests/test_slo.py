@@ -206,3 +206,42 @@ class TestSLOMonitor:
         win = snap["targets"]["ttft_slo"]["windows"]["100s/300s"]
         assert win["firing"]
         assert snap["alerts"] == [("ttft_slo", "100s/300s")]
+
+
+class TestResetWindows:
+    def test_reset_clears_burn_and_percentiles(self):
+        clk = FakeClock()
+        mon = monitor(clk, objective=0.9)
+        for _ in range(50):
+            mon.observe("ttft", 9.9)           # 100% bad -> 10x burn
+        t = mon.targets[0]
+        assert mon.burn_rate(t, 100.0) > 2.0
+        assert mon.snapshot()["percentiles"]["ttft"]["n"] == 50
+        mon.reset_windows("shift-1")
+        # all windows forgotten: burn is 0 until traffic refills them
+        assert mon.burn_rate(t, 100.0) == 0.0
+        assert mon.burn_rate(t, 300.0) == 0.0
+        assert mon.alerts() == []
+        assert mon.snapshot()["percentiles"]["ttft"]["n"] == 0
+        # post-reset observations accumulate from scratch
+        mon.observe("ttft", 0.1)
+        assert mon.burn_rate(t, 100.0) == 0.0  # 0 bad of 1
+        assert mon.snapshot()["percentiles"]["ttft"]["n"] == 1
+
+    def test_reset_bumps_epoch_and_tag(self):
+        mon = monitor(FakeClock())
+        assert mon.epoch == 0 and mon.epoch_tag is None
+        mon.reset_windows("shift-1")
+        assert mon.epoch == 1 and mon.epoch_tag == "shift-1"
+        mon.reset_windows()                    # tag optional
+        assert mon.epoch == 2 and mon.epoch_tag is None
+
+    def test_reset_exports_epoch_gauge(self):
+        clk = FakeClock()
+        reg = MetricsRegistry()
+        mon = monitor(clk, registry=reg)
+        mon.observe("ttft", 9.9)
+        mon.reset_windows("shift-3")
+        mon.reset_windows("shift-4")
+        assert reg.get("slo_window_epoch").value() == 2
+        assert "slo_window_epoch 2" in reg.prometheus()

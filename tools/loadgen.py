@@ -50,7 +50,13 @@ SLO burn are deterministic on any host:
 * ``diurnal`` — a sin²-modulated arrival rate (the traffic shape
   ROADMAP item 4's capacity shifting trains against);
 * ``bursty`` — synchronized arrival bursts driving overload, the
-  degradation ladder, shedding with retry_after, and client backoff.
+  degradation ladder, shedding with retry_after, and client backoff;
+* ``capacity_diurnal`` — the day-in-the-life capacity-shifting sim:
+  diurnal traffic against a fleet whose chip budget is shared with a
+  live :class:`~apex_tpu.resilience.elastic.ElasticTrainer` under a
+  burn-driven :class:`~apex_tpu.resilience.capacity.CapacityController`
+  (delegates to ``tools/day_in_life.py``, which owns the training side
+  and the hard gates).
 
 Every scenario report carries the exactly-once ledger (``submitted`` /
 ``lost`` / ``duplicated``), per-outcome counts, SLO attainment over the
@@ -80,7 +86,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 import jax            # noqa: E402
 import numpy as np    # noqa: E402
 
-SCENARIOS = ("steady", "replica_kill", "slow_replica", "diurnal", "bursty")
+SCENARIOS = ("steady", "replica_kill", "slow_replica", "diurnal", "bursty",
+             "capacity_diurnal")
 
 
 def _pct(xs, q):
@@ -279,7 +286,7 @@ def synthesize_scenario(args):
         while len(times) < n:
             times.extend([t] * min(args.burst_n, n - len(times)))
             t += args.burst_gap_s
-    elif args.scenario == "diurnal":
+    elif args.scenario in ("diurnal", "capacity_diurnal"):
         # thinning: candidate arrivals at the peak rate, accepted with
         # probability rate(t)/peak where rate(t) ~ sin^2 over --period-s
         t = 0.0
@@ -521,6 +528,19 @@ def main(argv=None) -> int:
     ap.add_argument("--max-seq", type=int, default=128)
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
+
+    if args.scenario == "capacity_diurnal":
+        # the capacity sim owns a training side too — delegate to the
+        # day-in-the-life driver, which reuses this module's fleet and
+        # workload helpers and adds the capacity gates
+        import day_in_life
+        report = day_in_life.run_day(day_in_life.day_args(
+            seed=args.seed, requests=args.requests, json_out=args.json))
+        if args.json:
+            print(json.dumps(report, indent=2))
+        else:
+            day_in_life.print_report(report)
+        return 0 if all(report["gates"].values()) else 1
 
     if args.scenario is not None:
         report = run_scenario(args)
