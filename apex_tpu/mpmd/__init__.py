@@ -1,0 +1,36 @@
+"""Cross-pod MPMD pipeline parallelism over the two-tier fabric.
+
+One pod = one SPMD program on its ICI mesh (the ring pipeline of
+:mod:`apex_tpu.transformer.pipeline_parallel` stays the intra-pod fast
+path and the bitwise reference).  Across pods there is no shared
+program: each pipeline stage compiles separately
+(:class:`StageProgram`), a host-driven schedule
+(:mod:`~apex_tpu.mpmd.schedule`) orders the work, and stage boundaries
+move through an explicit DCN channel
+(:class:`LocalDcnChannel` / retryable :class:`DcnTimeout`).  The
+:class:`MpmdPipeline` engine binds them and stays bitwise-equal (f32)
+to the ring engine at matching layouts.
+
+Plans: set ``n_pods > 1`` (and optionally per-pod ``stage_plans``) on
+a :class:`~apex_tpu.parallel.plan.ParallelPlan`;
+``tools/autotune.py --mpmd`` enumerates two-tier plans against
+per-link-class :class:`~apex_tpu.observability.costmodel.CostModel`
+fits.  See ``docs/parallel.md`` ("Two-tier MPMD") for the decision
+table versus single-mesh SPMD.
+"""
+
+from apex_tpu.mpmd.channel import DcnTimeout, Edge, LocalDcnChannel
+from apex_tpu.mpmd.engine import MPMD_PLAN_FILE, MpmdPipeline
+from apex_tpu.mpmd.schedule import (SCHEDULES, Op, edge_link_classes,
+                                    merge_stage_ops, schedule_1f1b,
+                                    schedule_dcn_hiding, simulate,
+                                    stage_ops_1f1b, validate_order)
+from apex_tpu.mpmd.stage import StageProgram
+
+__all__ = [
+    "DcnTimeout", "Edge", "LocalDcnChannel", "MpmdPipeline",
+    "MPMD_PLAN_FILE", "StageProgram", "Op", "SCHEDULES",
+    "schedule_1f1b", "schedule_dcn_hiding", "stage_ops_1f1b",
+    "merge_stage_ops", "validate_order", "edge_link_classes",
+    "simulate",
+]

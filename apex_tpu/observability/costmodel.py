@@ -16,9 +16,9 @@ Three pieces:
   device->host readback, min of rounds — ``block_until_ready`` can
   lie through remote-device tunnels);
 * :func:`fit_cost_model` — least-squares fit of the classic ring model
-  per (op, dtype): ``t = alpha * hops(k) + beta * wire_bytes(n, k)``
-  where ``hops`` is the number of serialized ring steps and
-  ``wire_bytes`` the per-link traffic (the same factors
+  per (op, dtype, link_class): ``t = alpha * hops(k) + beta *
+  wire_bytes(n, k)`` where ``hops`` is the number of serialized ring
+  steps and ``wire_bytes`` the per-link traffic (the same factors
   :func:`~apex_tpu.observability.comms.wire_bytes` applies) — alpha is
   the per-hop latency, beta the inverse link bandwidth;
 * :class:`CostModel` — ``predict(op, nbytes, group_size)`` in seconds,
@@ -28,6 +28,17 @@ Three pieces:
   (:meth:`CostModel.save` / :func:`load_profile`) so a profile taken
   once per machine is reusable across runs — and refused when the
   schema moved on.
+
+Two-tier fabrics (MPMD cross-pod pipelines, ``apex_tpu.mpmd``): every
+measurement and fit carries a ``link_class`` — ``"ici"`` for the
+intra-pod interconnect, ``"dcn"`` for the inter-pod network — probed
+as SEPARATE profiles, because one alpha-beta pair cannot describe both
+a ~1us ICI hop and a ~1ms DCN hop (AMP: placement must be
+heterogeneity-aware).  Profiles written before the field existed load
+as ``"ici"``; :meth:`CostModel.predict_stats` accepts a per-edge
+link-class map.  :func:`simulate_link_measurements` synthesizes a slow
+link's curve from explicit coefficients so the two-tier fit path runs
+on CPU-only CI (``tools/comms_probe.py --simulate-dcn alpha,beta``).
 
 ``tools/comms_probe.py`` is the CLI; ``__graft_entry__`` runs the
 probe+fit+validate loop on the CPU mesh as a dryrun leg (held-out
@@ -95,12 +106,15 @@ class Measurement:
     """One probed point: ``time_s`` (min of rounds) for one execution
     of ``op`` moving ``nbytes`` of payload over ``group_size`` devices.
     ``nbytes`` follows the comms accounting convention so measured
-    points line up with HLO-derived byte counts."""
+    points line up with HLO-derived byte counts.  ``link_class`` names
+    the fabric the point was taken on (``"ici"`` intra-pod, ``"dcn"``
+    cross-pod); points from before the field existed load as ici."""
     op: str
     dtype: str
     group_size: int
     nbytes: int
     time_s: float
+    link_class: str = "ici"
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -109,7 +123,8 @@ class Measurement:
     def from_dict(cls, d: dict) -> "Measurement":
         return cls(op=d["op"], dtype=d["dtype"],
                    group_size=int(d["group_size"]),
-                   nbytes=int(d["nbytes"]), time_s=float(d["time_s"]))
+                   nbytes=int(d["nbytes"]), time_s=float(d["time_s"]),
+                   link_class=str(d.get("link_class", "ici")))
 
 
 @dataclasses.dataclass
@@ -153,12 +168,13 @@ def _lstsq_fit(rows: List[Tuple[float, float, float]]) -> Tuple[float, float]:
 
 def fit_cost_model(measurements: Iterable[Measurement],
                    meta: Optional[dict] = None) -> "CostModel":
-    """Fit one :class:`CostFit` per (op, dtype) curve by least squares
-    over the ring design matrix ``[hops, wire_bytes]``."""
-    groups: Dict[Tuple[str, str], List[Measurement]] = {}
+    """Fit one :class:`CostFit` per (op, dtype, link_class) curve by
+    least squares over the ring design matrix ``[hops, wire_bytes]`` —
+    ici and dcn points never mix into one fit."""
+    groups: Dict[Tuple[str, str, str], List[Measurement]] = {}
     for m in measurements:
-        groups.setdefault((m.op, m.dtype), []).append(m)
-    fits: Dict[Tuple[str, str], CostFit] = {}
+        groups.setdefault((m.op, m.dtype, m.link_class), []).append(m)
+    fits: Dict[Tuple[str, str, str], CostFit] = {}
     for key, ms in groups.items():
         op = key[0]
         rows = [(ring_hops(op, m.group_size),
@@ -176,67 +192,122 @@ def fit_cost_model(measurements: Iterable[Measurement],
 
 
 class CostModel:
-    """Per-(op, dtype) alpha-beta ring model with a versioned profile.
+    """Per-(op, dtype, link_class) alpha-beta ring model with a
+    versioned profile.
 
     ``predict`` never raises on an unknown dtype — it falls back to the
     op's f32 curve, then to any curve for the op (a planner asking
     about an un-probed dtype should get the conservative wider-dtype
     estimate, not an exception mid-search) — but an unknown OP raises:
     silently guessing a collective's algorithm would corrupt a plan
-    comparison.
+    comparison.  An un-probed ``link_class`` falls back to the ici
+    curves the same way (the conservative choice would be the OTHER
+    direction, but a planner probing dcn explicitly gets dcn curves;
+    the fallback only covers profiles from before the tier existed).
+
+    ``fits`` is the pre-link-class view — the **ici** curves keyed
+    ``(op, dtype)`` — kept as the primary mutable mapping so existing
+    callers and saved-profile round-trips are unchanged; construct with
+    3-tuple keys ``(op, dtype, link_class)`` (or 2-tuple = ici) to
+    populate other tiers, and read the full set via :meth:`curves`.
     """
 
-    def __init__(self, fits: Dict[Tuple[str, str], CostFit],
+    def __init__(self, fits: Dict[tuple, CostFit],
                  meta: Optional[dict] = None):
-        self.fits = dict(fits)
+        self._by_class: Dict[str, Dict[Tuple[str, str], CostFit]] = {}
+        for key, fit in dict(fits).items():
+            if len(key) == 2:
+                op, dtype = key
+                lc = "ici"
+            else:
+                op, dtype, lc = key
+            self._by_class.setdefault(str(lc), {})[(op, dtype)] = fit
+        self._by_class.setdefault("ici", {})
         self.meta = dict(meta or {})
+
+    @property
+    def fits(self) -> Dict[Tuple[str, str], CostFit]:
+        """The ici curves keyed ``(op, dtype)`` (live view)."""
+        return self._by_class["ici"]
+
+    @property
+    def link_classes(self) -> Tuple[str, ...]:
+        return tuple(sorted(lc for lc, d in self._by_class.items() if d))
+
+    def curves(self) -> Dict[Tuple[str, str, str], CostFit]:
+        """Every fitted curve keyed ``(op, dtype, link_class)``."""
+        return {(op, dtype, lc): fit
+                for lc in sorted(self._by_class)
+                for (op, dtype), fit in sorted(self._by_class[lc].items())}
 
     # -- prediction ----------------------------------------------------------
 
-    def _fit_for(self, op: str, dtype: str) -> CostFit:
+    def _fit_for(self, op: str, dtype: str,
+                 link_class: str = "ici") -> CostFit:
         if op not in COLLECTIVE_OPS:
             raise ValueError(
                 f"unknown collective op {op!r}; probed ops are "
                 f"{COLLECTIVE_OPS}")
-        for key in ((op, dtype), (op, "f32")):
-            if key in self.fits:
-                return self.fits[key]
-        for (o, _), fit in sorted(self.fits.items()):
-            if o == op:
-                return fit
+        classes = [link_class] + (["ici"] if link_class != "ici" else [])
+        for lc in classes:
+            d = self._by_class.get(lc, {})
+            for key in ((op, dtype), (op, "f32")):
+                if key in d:
+                    return d[key]
+            for (o, _), fit in sorted(d.items()):
+                if o == op:
+                    return fit
+        for lc in sorted(self._by_class):
+            for (o, _), fit in sorted(self._by_class[lc].items()):
+                if o == op:
+                    return fit
         raise KeyError(f"no fitted curve for op {op!r} "
-                       f"(have {sorted(self.fits)})")
+                       f"(have {sorted(self.curves())})")
 
     def predict(self, op: str, nbytes: int, group_size: int,
-                dtype: str = "f32") -> float:
+                dtype: str = "f32", link_class: str = "ici") -> float:
         """Predicted seconds for one execution of ``op`` moving
-        ``nbytes`` of payload over a ``group_size`` ring."""
-        return self._fit_for(op, dtype).predict(op, nbytes, group_size)
+        ``nbytes`` of payload over a ``group_size`` ring on the
+        ``link_class`` fabric."""
+        return self._fit_for(op, dtype, link_class).predict(
+            op, nbytes, group_size)
 
     def predict_stats(self, stats: Dict[str, dict], group_size: int = 0,
-                      dtype: str = "f32") -> Dict[str, dict]:
+                      dtype: str = "f32",
+                      link_classes=None) -> Dict[str, dict]:
         """Predicted per-step communication time for a
         :func:`~apex_tpu.observability.comms.collective_stats` result.
 
         Per HLO kind: op count, payload bytes, and predicted seconds
         (ops without a parsed group size use ``group_size`` as the
         fallback ring width; 0 means "skip the latency term's hop
-        count scaling" — a 2-wide ring).  Returns the per-kind rows
-        plus ``{"total_s": ...}`` — the objective the auto-parallel
-        planner minimizes alongside compute time.
+        count scaling" — a 2-wide ring).  ``link_classes`` picks the
+        fabric per edge: a plain string prices every kind on that
+        fabric, a dict maps HLO kind -> link class (unlisted kinds stay
+        ici) — how the MPMD planner prices a program whose all-reduces
+        stay on ICI while its collective-permutes cross pods.  Returns
+        the per-kind rows plus ``{"total_s": ...}`` — the objective the
+        auto-parallel planner minimizes alongside compute time.
         """
+        if link_classes is None:
+            link_classes = {}
+        if isinstance(link_classes, str):
+            link_classes = {k: link_classes for k in HLO_KIND_TO_OP}
         out: Dict[str, dict] = {}
         total = 0.0
         for kind, op in HLO_KIND_TO_OP.items():
             row = stats.get(kind)
             if not row or not row.get("count"):
                 continue
+            lc = str(link_classes.get(kind, "ici"))
             pred = 0.0
             for o in row.get("ops", ()):
                 k = o.get("group_size") or group_size or 2
-                pred += self.predict(op, o["bytes"], k, dtype=dtype)
+                pred += self.predict(op, o["bytes"], k, dtype=dtype,
+                                     link_class=lc)
             out[kind] = {"count": row["count"], "bytes": row["bytes"],
-                         "pred_s": pred, "modeled_as": op}
+                         "pred_s": pred, "modeled_as": op,
+                         "link_class": lc}
             total += pred
         out["total_s"] = total
         return out
@@ -252,10 +323,11 @@ class CostModel:
         rows = []
         for m in measurements:
             pred = self.predict(m.op, m.nbytes, m.group_size,
-                                dtype=m.dtype)
+                                dtype=m.dtype, link_class=m.link_class)
             ratio = (pred / m.time_s if m.time_s > 0 else math.inf)
             rows.append({"op": m.op, "dtype": m.dtype,
                          "group_size": m.group_size, "nbytes": m.nbytes,
+                         "link_class": m.link_class,
                          "measured_s": m.time_s, "pred_s": pred,
                          "ratio": ratio})
         ratios = [r["ratio"] for r in rows if math.isfinite(r["ratio"])]
@@ -269,15 +341,24 @@ class CostModel:
     # -- profile JSON --------------------------------------------------------
 
     def to_json(self) -> dict:
-        return {
-            "version": PROFILE_VERSION,
-            "meta": self.meta,
-            "fits": {f"{op}|{dtype}": {
+        # ici curves keep their pre-link-class key form ("op|dtype") so
+        # older readers of a fresh profile still parse them; every entry
+        # carries an explicit link_class field, and non-ici curves get a
+        # third key segment to avoid collisions
+        fits = {}
+        for (op, dtype, lc), fit in self.curves().items():
+            key = f"{op}|{dtype}" if lc == "ici" else f"{op}|{dtype}|{lc}"
+            fits[key] = {
                 "alpha_s": fit.alpha_s,
                 "beta_s_per_byte": fit.beta_s_per_byte,
                 "n_points": fit.n_points,
                 "max_rel_err": fit.max_rel_err,
-            } for (op, dtype), fit in sorted(self.fits.items())},
+                "link_class": lc,
+            }
+        return {
+            "version": PROFILE_VERSION,
+            "meta": self.meta,
+            "fits": fits,
         }
 
     @classmethod
@@ -289,8 +370,13 @@ class CostModel:
                 f"{PROFILE_VERSION}; re-run tools/comms_probe.py")
         fits = {}
         for key, f in doc.get("fits", {}).items():
-            op, _, dtype = key.partition("|")
-            fits[(op, dtype)] = CostFit(
+            op, _, rest = key.partition("|")
+            dtype, _, key_lc = rest.partition("|")
+            # explicit field wins; then the key's third segment; a
+            # version-current profile with neither is pre-link-class
+            # data and loads as ici
+            lc = str(f.get("link_class") or key_lc or "ici")
+            fits[(op, dtype, lc)] = CostFit(
                 alpha_s=float(f["alpha_s"]),
                 beta_s_per_byte=float(f["beta_s_per_byte"]),
                 n_points=int(f.get("n_points", 0)),
@@ -323,12 +409,14 @@ def load_profile(path: str) -> Tuple[CostModel, List[Measurement]]:
 
 def holdout_split(measurements: Sequence[Measurement], every: int = 3
                   ) -> Tuple[List[Measurement], List[Measurement]]:
-    """(train, held_out): within each (op, dtype, group) curve, hold
-    out every ``every``-th point by size rank — interpolation-regime
-    validation, which is what the planner asks of the model."""
-    curves: Dict[Tuple[str, str, int], List[Measurement]] = {}
+    """(train, held_out): within each (op, dtype, link_class, group)
+    curve, hold out every ``every``-th point by size rank —
+    interpolation-regime validation, which is what the planner asks of
+    the model."""
+    curves: Dict[Tuple[str, str, str, int], List[Measurement]] = {}
     for m in measurements:
-        curves.setdefault((m.op, m.dtype, m.group_size), []).append(m)
+        curves.setdefault((m.op, m.dtype, m.link_class, m.group_size),
+                          []).append(m)
     train: List[Measurement] = []
     held: List[Measurement] = []
     for ms in curves.values():
@@ -364,8 +452,13 @@ def probe_collectives(ops: Sequence[str] = COLLECTIVE_OPS,
                       group_sizes: Optional[Sequence[int]] = None,
                       iters: int = 4, rounds: int = 5,
                       warmup: int = 1,
+                      link_class: str = "ici",
                       verbose: bool = False) -> List[Measurement]:
     """Microbenchmark the ring collectives on the current backend.
+
+    ``link_class`` tags every measurement with the fabric being probed
+    — run once per tier (on a mesh whose rings actually cross that
+    fabric) to build a two-tier profile.
 
     ``sizes`` are PER-DEVICE local buffer bytes; each (op, dtype,
     group, size) cell is one jitted shard_map program timed with the
@@ -454,10 +547,55 @@ def probe_collectives(ops: Sequence[str] = COLLECTIVE_OPS,
                     m = Measurement(
                         op=op, dtype=dtype, group_size=k,
                         nbytes=_payload_bytes(op, dtype, n_local, k),
-                        time_s=t)
+                        time_s=t, link_class=link_class)
                     out.append(m)
                     if verbose:
                         print(f"probe {op:<13} {dtype:<5} k={k} "
                               f"payload={m.nbytes:>10,}B  "
                               f"t={t * 1e6:.1f}us")
+    return out
+
+
+def simulate_link_measurements(
+        alpha_s: float, beta_s_per_byte: float, *,
+        link_class: str = "dcn",
+        ops: Sequence[str] = COLLECTIVE_OPS,
+        dtypes: Sequence[str] = ("f32",),
+        sizes: Sequence[int] = (1 << 12, 1 << 14, 1 << 16, 1 << 18,
+                                1 << 20),
+        group_sizes: Sequence[int] = (2, 4),
+        rel_noise: float = 0.0, seed: int = 0) -> List[Measurement]:
+    """Synthesize measurements for a link that cannot be probed here.
+
+    Times follow the ring model exactly — ``t = alpha*hops +
+    beta*wire_bytes`` — so a fit over the output recovers the given
+    coefficients (``rel_noise`` adds deterministic multiplicative
+    jitter when a less-than-perfect curve is wanted).  This is how a
+    CPU-only CI exercises the dcn tier end to end: inject a slow
+    link's alpha-beta, fit, and drive the MPMD planner/simulator with
+    the result (``tools/comms_probe.py --simulate-dcn alpha,beta``).
+    """
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    out: List[Measurement] = []
+    for op in ops:
+        for dtype in dtypes:
+            width = _DTYPE_WIDTH[dtype]
+            for k in group_sizes:
+                for nbytes_local in sizes:
+                    n_local = max(nbytes_local // width, k)
+                    n_local -= n_local % k
+                    n_local = max(n_local, k)
+                    nbytes = _payload_bytes(op, dtype, n_local, k)
+                    t = (alpha_s * ring_hops(op, k)
+                         + beta_s_per_byte
+                         * ring_wire_bytes(op, nbytes, k))
+                    if rel_noise:
+                        t *= 1.0 + rel_noise * float(
+                            rng.uniform(-1.0, 1.0))
+                    out.append(Measurement(
+                        op=op, dtype=dtype, group_size=k,
+                        nbytes=nbytes, time_s=t,
+                        link_class=link_class))
     return out

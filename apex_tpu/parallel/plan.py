@@ -59,6 +59,15 @@ class ParallelPlan:
     distributed optimizers); ``allreduce_dtype`` the ZeRO gradient
     reduce-scatter transport (None/'f32' exact, 'bf16'/'int8'
     compressed — see :mod:`apex_tpu.utils.compressed_allreduce`).
+
+    Cross-pod (MPMD, ``apex_tpu.mpmd``): ``n_pods > 1`` splits the
+    ``pp`` pipeline stages into contiguous per-pod blocks whose
+    boundary edges cross the slow (DCN) tier; ``stage_plans`` — one
+    intra-pod SPMD plan per pod (``pp=1``, ``n_pods=1``, same ``dp``)
+    — lets pods run heterogeneous tp/SP layouts.  Plans with
+    ``n_pods > 1`` are executed by the host-driven
+    :class:`~apex_tpu.mpmd.MpmdPipeline`, not the single-program ring
+    engine.
     """
 
     dp: int = 1
@@ -72,10 +81,12 @@ class ParallelPlan:
     remat_policy: str = "full"
     allreduce_dtype: Optional[str] = None
     zero_shard: int = 1
+    n_pods: int = 1
+    stage_plans: Optional[tuple] = None
 
     def __post_init__(self):
         for name in ("dp", "tp", "pp", "n_virtual", "n_microbatches",
-                     "zero_shard"):
+                     "zero_shard", "n_pods"):
             v = getattr(self, name)
             if not isinstance(v, (int, np.integer)) or isinstance(v, bool) \
                     or v < 1:
@@ -116,11 +127,75 @@ class ParallelPlan:
         # the JSON round-trip) has one canonical form
         if self.allreduce_dtype == "f32":
             object.__setattr__(self, "allreduce_dtype", None)
+        self._validate_cross_pod()
+
+    def _validate_cross_pod(self):
+        if self.pp % self.n_pods:
+            raise ValueError(
+                f"n_pods ({self.n_pods}) must divide pp ({self.pp}): "
+                "cross-pod MPMD assigns each pod a contiguous block of "
+                f"pp/n_pods pipeline stages — pick pp a multiple of "
+                "n_pods (or drop n_pods for a single-pod ring pipeline)")
+        if self.n_pods > 1 and self.n_virtual > 1:
+            raise ValueError(
+                f"n_virtual ({self.n_virtual}) > 1 does not compose "
+                f"with n_pods ({self.n_pods}) > 1: the interleaved "
+                "virtual-stage schedule belongs to the single-program "
+                "ring engine, while the MPMD engine schedules whole "
+                "per-pod stage programs — set n_virtual=1, or keep the "
+                "pipeline inside one pod for interleaving")
+        if self.stage_plans is None:
+            return
+        if self.n_pods <= 1:
+            raise ValueError(
+                f"stage_plans given but n_pods is {self.n_pods}: "
+                "per-stage plans describe the intra-pod layout of an "
+                "MPMD cross-pod pipeline — set n_pods > 1 (one plan "
+                "per pod), or drop stage_plans to run the single-pod "
+                "ring engine")
+        plans = self.stage_plans
+        if isinstance(plans, ParallelPlan) or not isinstance(
+                plans, (tuple, list)):
+            raise ValueError(
+                f"stage_plans must be a sequence of ParallelPlan (one "
+                f"per pod), got {type(plans).__name__}")
+        plans = tuple(
+            p if isinstance(p, ParallelPlan) else ParallelPlan.from_dict(p)
+            for p in plans)
+        if len(plans) != self.n_pods:
+            raise ValueError(
+                f"stage_plans has {len(plans)} entries but n_pods is "
+                f"{self.n_pods}: exactly one intra-pod plan per pod — "
+                "pods without an override should carry an explicit "
+                "default plan, not be omitted")
+        for i, sp in enumerate(plans):
+            if sp.pp != 1 or sp.n_pods != 1 or sp.stage_plans is not None:
+                raise ValueError(
+                    f"stage_plans[{i}] must be an intra-pod SPMD plan "
+                    f"with pp=1 and n_pods=1 (got pp={sp.pp}, "
+                    f"n_pods={sp.n_pods}): the cross-pod schedule owns "
+                    "the pipeline dimension — nested pipelines/pods are "
+                    "not supported; fold extra stages into pp on the "
+                    "cross-pod plan instead")
+            if sp.dp != self.dp:
+                raise ValueError(
+                    f"stage_plans[{i}].dp ({sp.dp}) must equal the "
+                    f"cross-pod plan's dp ({self.dp}): activations "
+                    "cross the DCN per data shard, so every pod must "
+                    "slice the batch identically — vary tp/SP per pod, "
+                    "not dp")
+        object.__setattr__(self, "stage_plans", plans)
 
     # -- projections ---------------------------------------------------------
 
     @property
     def n_devices(self) -> int:
+        if self.stage_plans is not None:
+            # heterogeneous pods: each of the pp stage programs owns
+            # its pod's dp x tp worth of devices
+            per_pod_stages = self.pp // self.n_pods
+            return per_pod_stages * sum(sp.dp * sp.tp
+                                        for sp in self.stage_plans)
         return self.dp * self.tp * self.pp
 
     @property
@@ -171,16 +246,23 @@ class ParallelPlan:
     # -- serialization -------------------------------------------------------
 
     def to_dict(self) -> dict:
-        return {"version": PLAN_VERSION,
-                "dp": int(self.dp), "tp": int(self.tp), "pp": int(self.pp),
-                "sequence_parallel": bool(self.sequence_parallel),
-                "overlap_chunks": int(self.overlap_chunks),
-                "n_virtual": int(self.n_virtual),
-                "n_microbatches": int(self.n_microbatches),
-                "remat": bool(self.remat),
-                "remat_policy": str(self.remat_policy),
-                "allreduce_dtype": self.allreduce_dtype,
-                "zero_shard": int(self.zero_shard)}
+        d = {"version": PLAN_VERSION,
+             "dp": int(self.dp), "tp": int(self.tp), "pp": int(self.pp),
+             "sequence_parallel": bool(self.sequence_parallel),
+             "overlap_chunks": int(self.overlap_chunks),
+             "n_virtual": int(self.n_virtual),
+             "n_microbatches": int(self.n_microbatches),
+             "remat": bool(self.remat),
+             "remat_policy": str(self.remat_policy),
+             "allreduce_dtype": self.allreduce_dtype,
+             "zero_shard": int(self.zero_shard)}
+        # cross-pod fields only when set, so single-pod plan documents
+        # stay byte-identical to pre-MPMD writers
+        if self.n_pods != 1:
+            d["n_pods"] = int(self.n_pods)
+        if self.stage_plans is not None:
+            d["stage_plans"] = [sp.to_dict() for sp in self.stage_plans]
+        return d
 
     @classmethod
     def from_dict(cls, d: dict, **overrides) -> "ParallelPlan":
@@ -202,7 +284,11 @@ class ParallelPlan:
               "remat": bool(d.get("remat", False)),
               "remat_policy": str(d.get("remat_policy", "full")),
               "allreduce_dtype": d.get("allreduce_dtype"),
-              "zero_shard": int(d.get("zero_shard", 1))}
+              "zero_shard": int(d.get("zero_shard", 1)),
+              "n_pods": int(d.get("n_pods", 1))}
+        if d.get("stage_plans") is not None:
+            kw["stage_plans"] = tuple(
+                cls.from_dict(sp) for sp in d["stage_plans"])
         kw.update(overrides)
         return cls(**kw)
 
@@ -220,6 +306,11 @@ class ParallelPlan:
             bits.append(f"remat={self.remat_policy}")
         if self.allreduce_dtype:
             bits.append(f"rs={self.allreduce_dtype}")
+        if self.n_pods > 1:
+            bits.append(f"pods={self.n_pods}")
+            if self.stage_plans is not None:
+                bits.append("stages=[" + "; ".join(
+                    sp.describe() for sp in self.stage_plans) + "]")
         return " ".join(bits)
 
 

@@ -153,6 +153,13 @@ class ElasticPlan:
         parallel = None
         if not isinstance(spec, TopologySpec) and hasattr(spec, "topology"):
             parallel = spec
+            if getattr(parallel, "n_pods", 1) > 1:
+                raise ValueError(
+                    f"plan has n_pods={parallel.n_pods}: a cross-pod "
+                    "MPMD plan spans multiple meshes and cannot build "
+                    "one ElasticPlan — run it with "
+                    "apex_tpu.mpmd.MpmdPipeline (per-stage programs), "
+                    "or set n_pods=1 for a single-mesh ring pipeline")
             spec = spec.topology()
         devices = list(devices) if devices is not None else jax.devices()
         n = spec.n_devices

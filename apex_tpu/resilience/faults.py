@@ -26,12 +26,18 @@ same failure sequence on every run.  Kinds:
                     ``apex_tpu.resilience.capacity.fault_mode``); consumed
                     via :meth:`FaultInjector.check_capacity_change` by the
                     :class:`~apex_tpu.resilience.capacity.CapacityController`
+``dcn_fault``       a cross-pod (DCN) activation/cotangent transfer at that
+                    step drops/times out: the MPMD channel raises the
+                    retryable :class:`apex_tpu.mpmd.DcnTimeout`; consumed
+                    (recorded + removed) via :meth:`FaultInjector.check_dcn`
+                    so the engine's resend succeeds
 =================== =========================================================
 
-``capacity_change`` is appended LAST so :meth:`FaultInjector.from_seed`
+Every new kind is appended LAST so :meth:`FaultInjector.from_seed`
 schedules for the pre-existing kinds are byte-identical to before it
 existed — ``seeded_schedule`` consumes no rng state for rate-0 kinds
-(asserted by ``tests/test_capacity.py``).
+(asserted by ``tests/test_capacity.py`` for ``capacity_change`` and
+``tests/test_mpmd.py`` for ``dcn_fault``).
 
 The in-jit kinds are injected as DATA, not control flow:
 :meth:`grad_flags` returns three scalars the guarded train step folds in
@@ -49,7 +55,7 @@ import numpy as np
 
 FAULT_KINDS = ("nan_grads", "inf_loss", "grad_spike", "preempt_at_step",
                "corrupt_checkpoint", "slow_host", "topology_change",
-               "capacity_change")
+               "capacity_change", "dcn_fault")
 
 # the serving-side fault kinds live in apex_tpu.serving.fleet
 # (SERVING_FAULT_KINDS); its ServingFaultInjector generates schedules
@@ -211,6 +217,18 @@ class FaultInjector:
         f = self._find(step, "capacity_change")
         if f is not None:
             self.record(step, "capacity_change")
+            self._by_step[step].remove(f)
+        return f
+
+    def check_dcn(self, step: int) -> Optional[Fault]:
+        """The scheduled ``dcn_fault`` at ``step``, if any — consumed
+        (recorded + removed) so one scheduled fault drops one cross-pod
+        transfer: the MPMD channel's retry of the same send must be
+        able to succeed.  ``magnitude`` is reserved for failure-mode
+        selection (0 = dropped/timed-out send)."""
+        f = self._find(step, "dcn_fault")
+        if f is not None:
+            self.record(step, "dcn_fault")
             self._by_step[step].remove(f)
         return f
 

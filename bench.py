@@ -1747,6 +1747,57 @@ def bench_autotune():
             "total_wall_s": round(wall, 3)}
 
 
+def bench_mpmd():
+    """Cross-pod MPMD schedule leg (ISSUE 14): how much of a slow DCN
+    hop each schedule hides.
+
+    Prices classic 1F1B under blocking sends (the lockstep/SPMD model:
+    every inter-pod hop sits on the critical path) against the
+    ``dcn_hiding`` schedule under asynchronous sends (the MPMD host
+    model: extra in-flight microbatches buffer the hop) with the
+    ``apex_tpu.mpmd.schedule.simulate`` event model — 4 stages split
+    across 2 pods, the DCN edge costing ~half a forward.  Pure host
+    arithmetic (no devices), so the recorded bubble fractions are
+    deterministic across rounds and ``bench_diff``-able; the MPMD
+    engine's numerics ride the tier-1 gate
+    (``__graft_entry__._dryrun_mpmd``), not this leg."""
+    from apex_tpu.mpmd.schedule import (SCHEDULES, edge_link_classes,
+                                        simulate)
+
+    S, M, pods = 4, 8, 2
+    t_fwd, t_bwd = 1.0, 2.0
+    classes = edge_link_classes(S, pods)
+    rows = {}
+    for dcn_s in (0.0, 1.5):
+        link = {e: (dcn_s if lc == "dcn" else 0.05)
+                for e, lc in classes.items()}
+        for name in ("1f1b", "dcn_hiding"):
+            sim = simulate(SCHEDULES[name](S, M), S, M, t_fwd=t_fwd,
+                           t_bwd=t_bwd, link_seconds=link,
+                           link_classes=classes,
+                           blocking_sends=(name == "1f1b"))
+            rows[f"{name}_dcn{dcn_s:g}"] = {
+                "makespan": round(sim["makespan"], 3),
+                "bubble_fraction": round(sim["bubble_fraction"], 4),
+                "dcn_hidden_fraction": round(
+                    sim["hidden_fraction"]["dcn"], 4),
+            }
+    slow_base = rows["1f1b_dcn1.5"]
+    slow_tuned = rows["dcn_hiding_dcn1.5"]
+    return {
+        "stages": S, "microbatches": M, "pods": pods,
+        "t_fwd": t_fwd, "t_bwd": t_bwd, "dcn_link_s": 1.5,
+        "schedules": rows,
+        "bubble_reduction_vs_1f1b": round(
+            slow_base["bubble_fraction"] - slow_tuned["bubble_fraction"],
+            4),
+        "speedup_vs_1f1b": round(
+            slow_base["makespan"] / slow_tuned["makespan"], 4),
+        "dcn_tuned_wins": bool(
+            slow_tuned["bubble_fraction"] < slow_base["bubble_fraction"]),
+    }
+
+
 def main():
     backend = jax.default_backend()
     # every leg's result also lands on the metrics registry as one
@@ -1783,6 +1834,7 @@ def main():
     serving_chaos = _retry(bench_serving_chaos)
     lint_gate = _retry(bench_lint)
     autotune_leg = _retry(bench_autotune)
+    mpmd = _retry(bench_mpmd)
     rounded = lambda d: (None if d is None else
                          {k: (round(v, 6) if isinstance(v, float) else v)
                           for k, v in d.items()})
@@ -1816,6 +1868,7 @@ def main():
             "serving_chaos": serving_chaos,
             "lint": lint_gate,
             "autotune": autotune_leg,
+            "mpmd": mpmd,
         },
     }
     result["metrics_stream"] = stream_path

@@ -396,12 +396,13 @@ def test_mid_shift_crash_restores_real_trainer_bitwise(tmp_path):
 
 
 def test_train_from_seed_schedule_unchanged_by_capacity_kind():
-    assert FAULT_KINDS[-1] == "capacity_change"
-    rates = {k: 0.15 for k in FAULT_KINDS if k != "capacity_change"}
+    # kinds newer than capacity_change (e.g. dcn_fault) append AFTER it
+    idx = FAULT_KINDS.index("capacity_change")
+    rates = {k: 0.15 for k in FAULT_KINDS[:idx]}
     inj = FaultInjector.from_seed(5, 40, rates)
     # the schedule must equal the one generated over the PRE-EXISTING
     # kind tuple: a rate-0 kind consumes no rng stream state
-    expected = seeded_schedule(5, 40, FAULT_KINDS[:-1], rates)
+    expected = seeded_schedule(5, 40, FAULT_KINDS[:idx], rates)
     assert [(f.step, f.kind) for f in inj.schedule] == expected
     assert expected                               # non-vacuous
 

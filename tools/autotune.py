@@ -33,6 +33,16 @@ Usage:
         --out plan.json
     python tools/autotune.py --devices 8 --profile comms_profile.json \\
         --hbm-gb 0.5 --top-k 3 --out plan.json
+
+``--mpmd`` switches to the two-tier cross-pod planner: enumerate
+``(pp, per-stage dp x tp, M)`` plans for ``--pods`` pod blocks, price
+each under both MPMD schedules with the
+:func:`apex_tpu.mpmd.schedule.simulate` event model (ICI edges from
+the profile's ``ici`` fits, DCN edges from its ``dcn`` fits or an
+explicit ``--dcn alpha,beta``), and emit the winning plan + schedule:
+
+    python tools/autotune.py --devices 8 --mpmd --pods 2 \\
+        --dcn 1e-3,1e-9 --out mpmd_plan.json
 """
 
 from __future__ import annotations
@@ -365,6 +375,206 @@ def _default_cost_model(n_devices: int):
     return fit_cost_model(ms, meta={"source": "autotune-inline-probe"})
 
 
+# -- two-tier MPMD planner ----------------------------------------------------
+
+
+def enumerate_mpmd_space(n_devices: int, *, n_layers: int, n_heads: int,
+                         batch: int, seq: int, n_pods: int,
+                         max_tp: Optional[int] = None) -> List[Candidate]:
+    """Cross-pod candidates: ``pp`` stages (a multiple of ``n_pods``)
+    times a per-stage ``dp x tp`` mesh, each stage its own program
+    (``apex_tpu.mpmd``).  Same keep-the-rejections convention as
+    :func:`enumerate_space`; every valid plan carries ``n_pods``."""
+    from apex_tpu.parallel.plan import ParallelPlan
+
+    out: List[Candidate] = []
+    seen = set()
+
+    def reject(reason, **kw):
+        key = ("r", tuple(sorted(kw.items())))
+        if key not in seen:
+            seen.add(key)
+            out.append(Candidate(plan=dict(kw), status="rejected",
+                                 reason=reason))
+
+    for pp in _divisors(n_devices):
+        if pp < 2 or pp % n_pods:
+            continue
+        if n_layers % pp:
+            reject(f"num_layers={n_layers} not divisible by pp={pp}",
+                   pp=pp, n_pods=n_pods)
+            continue
+        for dp in _divisors(n_devices // pp):
+            tp = n_devices // (pp * dp)
+            if max_tp is not None and tp > max_tp:
+                continue
+            if n_heads % tp:
+                reject(f"num_attention_heads={n_heads} not divisible "
+                       f"by tp={tp}", dp=dp, tp=tp, pp=pp,
+                       n_pods=n_pods)
+                continue
+            if batch % dp:
+                reject(f"batch={batch} not divisible by dp={dp}",
+                       dp=dp, tp=tp, pp=pp, n_pods=n_pods)
+                continue
+            sp = tp > 1
+            if sp and seq % tp:
+                reject(f"seq={seq} not divisible by tp={tp} "
+                       "(SP shards the sequence axis)",
+                       dp=dp, tp=tp, pp=pp, n_pods=n_pods,
+                       sequence_parallel=True)
+                continue
+            for M in (pp, 2 * pp):
+                if (batch // dp) % M:
+                    reject(f"per-dp batch {batch // dp} not divisible "
+                           f"by n_microbatches={M}", dp=dp, tp=tp,
+                           pp=pp, n_pods=n_pods, n_microbatches=M)
+                    continue
+                key = ("p", dp, tp, pp, M)
+                if key in seen:
+                    continue
+                seen.add(key)
+                try:
+                    out.append(Candidate(plan=ParallelPlan(
+                        dp=dp, tp=tp, pp=pp, sequence_parallel=sp,
+                        n_microbatches=M, n_pods=n_pods)))
+                except ValueError as e:
+                    out.append(Candidate(
+                        plan=dict(dp=dp, tp=tp, pp=pp, n_pods=n_pods,
+                                  n_microbatches=M),
+                        status="rejected", reason=str(e)))
+    return out
+
+
+def simulate_mpmd(plan, schedule_name: str, *, n_params: int,
+                  batch: int, seq: int, hidden: int,
+                  flops_per_s: float, cost_model=None,
+                  dcn: Optional[Tuple[float, float]] = None) -> dict:
+    """Price one cross-pod candidate with the schedule simulator.
+
+    Stage compute comes from the 6ND roofline split over ``pp`` stage
+    chunks and each stage's ``dp * tp`` devices (backward = 2x
+    forward); each edge carries one microbatch's global activation
+    (``batch/M * seq * hidden`` f32) priced on ITS link class —
+    ``ppermute`` fits from ``cost_model``, or an explicit ``dcn``
+    ``(alpha_s, beta_s_per_byte)`` override for the DCN edges.  The
+    ``1f1b`` schedule runs with blocking sends (the lockstep/SPMD
+    model: every hop sits on the critical path) and ``dcn_hiding``
+    with asynchronous sends (the MPMD host model) — the two execution
+    semantics the two engines actually have.
+    """
+    from apex_tpu.mpmd.schedule import (SCHEDULES, edge_link_classes,
+                                        simulate)
+
+    S, M = plan.pp, plan.n_microbatches
+    tokens_per_mb = (batch // M) * seq
+    stage_flops_fwd = 2.0 * (float(n_params) / S) * tokens_per_mb
+    t_fwd = stage_flops_fwd / (plan.dp * plan.tp * flops_per_s)
+    t_bwd = 2.0 * t_fwd
+    act_bytes = (batch // M) * seq * hidden * 4
+    classes = edge_link_classes(S, plan.n_pods)
+    link_seconds = {}
+    for e, lc in classes.items():
+        if lc == "dcn" and dcn is not None:
+            link_seconds[e] = dcn[0] + dcn[1] * act_bytes
+        elif cost_model is not None:
+            link_seconds[e] = cost_model.predict(
+                "ppermute", act_bytes, 2, link_class=lc)
+        else:
+            link_seconds[e] = 0.0
+    order = SCHEDULES[schedule_name](S, M)
+    sim = simulate(order, S, M, t_fwd=t_fwd, t_bwd=t_bwd,
+                   link_seconds=link_seconds, link_classes=classes,
+                   blocking_sends=(schedule_name == "1f1b"))
+    sim["t_fwd"] = t_fwd
+    sim["t_bwd"] = t_bwd
+    sim["act_bytes"] = act_bytes
+    sim["link_seconds"] = {str(e): s for e, s in link_seconds.items()}
+    return sim
+
+
+def autotune_mpmd(n_devices: int, *, cfg_kw: Optional[dict] = None,
+                  batch: int = 8, seq: Optional[int] = None,
+                  n_pods: int = 2, cost_model=None,
+                  dcn: Optional[Tuple[float, float]] = None,
+                  max_tp: Optional[int] = None,
+                  verbose: bool = True) -> dict:
+    """Enumerate and rank two-tier (ICI + DCN) MPMD plans.
+
+    Pure simulation — no per-candidate compiles: the cross-pod search
+    only has to order plans by how well their schedule hides the DCN
+    edges, and the simulator prices exactly that.  Every candidate is
+    scored under BOTH schedules; the report's winner carries the
+    schedule name to hand to :class:`~apex_tpu.mpmd.MpmdPipeline`.
+    """
+    import jax
+    import numpy as np
+
+    def say(msg):
+        if verbose:
+            print(msg, flush=True)
+
+    cfg_kw = dict(cfg_kw or DEFAULT_MODEL)
+    seq = seq if seq is not None else cfg_kw["max_seq_len"]
+    if cost_model is None and dcn is None:
+        say("no comms profile or --dcn given; probing ici in-process")
+        cost_model = _default_cost_model(n_devices)
+
+    from apex_tpu.models.gpt import GPTConfig, GPTModel
+    serial = GPTModel(GPTConfig(**cfg_kw))
+    params = serial.init_params(jax.random.PRNGKey(0))
+    n_params = sum(int(np.prod(l.shape))
+                   for l in jax.tree_util.tree_leaves(params))
+    flops_per_s = calibrate_matmul_flops()
+
+    cands = enumerate_mpmd_space(
+        n_devices, n_layers=cfg_kw["num_layers"],
+        n_heads=cfg_kw["num_attention_heads"], batch=batch, seq=seq,
+        n_pods=n_pods, max_tp=max_tp)
+    valid = [c for c in cands if c.status == "enumerated"]
+    say(f"enumerated {len(cands)} cross-pod points: {len(valid)} valid")
+    if not valid:
+        raise RuntimeError(
+            f"no valid MPMD plan for {n_devices} devices / "
+            f"{n_pods} pods — see the report's rejection reasons")
+
+    rows = []
+    for c in valid:
+        for name in ("1f1b", "dcn_hiding"):
+            sim = simulate_mpmd(
+                c.plan, name, n_params=n_params, batch=batch, seq=seq,
+                hidden=cfg_kw["hidden_size"], flops_per_s=flops_per_s,
+                cost_model=cost_model, dcn=dcn)
+            rows.append({"plan": c.plan.to_dict(), "schedule": name,
+                         "predicted_s": sim["makespan"],
+                         "bubble_fraction": sim["bubble_fraction"],
+                         "dcn_hidden_fraction":
+                             sim["hidden_fraction"]["dcn"]})
+        c.status = "ranked"
+        c.predicted_s = min(r["predicted_s"] for r in rows[-2:])
+    rows.sort(key=lambda r: r["predicted_s"])
+    win = rows[0]
+    say(f"winner: {win['plan']} schedule={win['schedule']} "
+        f"pred={win['predicted_s'] * 1e3:.3f} ms/step "
+        f"bubble={win['bubble_fraction']:.3f} "
+        f"dcn_hidden={win['dcn_hidden_fraction']:.3f}")
+    return {
+        "version": AUTOTUNE_VERSION,
+        "mode": "mpmd",
+        "n_devices": n_devices,
+        "n_pods": n_pods,
+        "model": cfg_kw,
+        "batch": batch,
+        "seq": seq,
+        "flops_per_s": flops_per_s,
+        "plan": win["plan"],
+        "schedule": win["schedule"],
+        "predicted_s": win["predicted_s"],
+        "ranked": rows,
+        "candidates": [c.to_dict() for c in cands],
+    }
+
+
 # -- the planner --------------------------------------------------------------
 
 
@@ -528,6 +738,17 @@ def main(argv=None):
                     help="global batch rows for the probe workload")
     ap.add_argument("--max-tp", type=int, default=None)
     ap.add_argument("--max-pp", type=int, default=None)
+    ap.add_argument("--mpmd", action="store_true",
+                    help="plan a cross-pod MPMD pipeline "
+                         "(apex_tpu.mpmd) instead of a single mesh")
+    ap.add_argument("--pods", type=int, default=2,
+                    help="pod count for --mpmd (stages split into "
+                         "this many contiguous blocks; adjacent "
+                         "blocks joined by DCN)")
+    ap.add_argument("--dcn", default=None, metavar="ALPHA,BETA",
+                    help="price DCN edges as alpha_s,beta_s_per_byte "
+                         "instead of a profile's dcn fits (e.g. "
+                         "1e-3,1e-9)")
     ap.add_argument("--no-zero", action="store_true",
                     help="drop ZeRO (zero_shard > 1) candidates")
     ap.add_argument("--no-remat", action="store_true",
@@ -551,12 +772,22 @@ def main(argv=None):
         from apex_tpu.observability.costmodel import load_profile
         cost_model, _ = load_profile(args.profile)
 
-    report = autotune(
-        n, hbm_bytes=args.hbm_gb * (1 << 30), cost_model=cost_model,
-        top_k=args.top_k, batch=args.batch, max_tp=args.max_tp,
-        max_pp=args.max_pp, zero=not args.no_zero,
-        remat_options=(False,) if args.no_remat else (False, True),
-        verbose=not args.quiet)
+    if args.mpmd:
+        dcn = None
+        if args.dcn is not None:
+            a, b = args.dcn.split(",")
+            dcn = (float(a), float(b))
+        report = autotune_mpmd(
+            n, batch=args.batch, n_pods=args.pods,
+            cost_model=cost_model, dcn=dcn, max_tp=args.max_tp,
+            verbose=not args.quiet)
+    else:
+        report = autotune(
+            n, hbm_bytes=args.hbm_gb * (1 << 30), cost_model=cost_model,
+            top_k=args.top_k, batch=args.batch, max_tp=args.max_tp,
+            max_pp=args.max_pp, zero=not args.no_zero,
+            remat_options=(False,) if args.no_remat else (False, True),
+            verbose=not args.quiet)
     emit_plan(args.out, report)
     if not args.quiet:
         print(f"wrote {args.out}")

@@ -16,6 +16,15 @@ Usage:
     python tools/comms_probe.py --check profile.json   # re-validate a
         saved profile's fits against its own stored measurements
 
+Two-tier (MPMD cross-pod) profiles: ``--link-class dcn`` tags the
+probed measurements as the slow tier (run it on a mesh whose rings
+actually cross the data-center network); ``--simulate-dcn alpha,beta``
+instead synthesizes an exact dcn curve from the given per-hop latency
+(seconds) and inverse bandwidth (seconds/byte) — the CPU-only CI path
+for exercising the two-tier fit, e.g. ``--simulate-dcn 1e-3,1e-8``.
+Both land in the same profile JSON; curves carry a ``link_class``
+field and pre-link-class profiles load as ici.
+
 On a CPU host, 8 virtual devices come from
 ``XLA_FLAGS=--xla_force_host_platform_device_count=8``.
 """
@@ -61,8 +70,24 @@ def main(argv=None):
     ap.add_argument("--check", metavar="PROFILE", default=None,
                     help="skip probing; re-validate PROFILE against "
                          "its stored measurements")
+    ap.add_argument("--link-class", default="ici",
+                    help="fabric tag for the probed measurements "
+                         "(ici | dcn; default ici)")
+    ap.add_argument("--simulate-dcn", metavar="ALPHA,BETA", default=None,
+                    help="also inject a synthetic dcn curve with the "
+                         "given per-hop latency (s) and inverse "
+                         "bandwidth (s/byte), e.g. 1e-3,1e-8 — the "
+                         "CPU-only CI path for two-tier fits")
     ap.add_argument("-q", "--quiet", action="store_true")
     args = ap.parse_args(argv)
+
+    simulate_dcn = None
+    if args.simulate_dcn is not None:
+        parts = [p for p in args.simulate_dcn.split(",") if p]
+        if len(parts) != 2:
+            ap.error("--simulate-dcn wants 'alpha,beta' "
+                     "(seconds, seconds/byte), e.g. 1e-3,1e-8")
+        simulate_dcn = (float(parts[0]), float(parts[1]))
 
     import jax
 
@@ -76,7 +101,7 @@ def main(argv=None):
 
     from apex_tpu.observability.costmodel import (
         Measurement, fit_cost_model, holdout_split, load_profile,
-        probe_collectives)
+        probe_collectives, simulate_link_measurements)
 
     if args.check:
         model, ms = load_profile(args.check)
@@ -96,10 +121,15 @@ def main(argv=None):
     measurements = probe_collectives(
         ops=ops, dtypes=args.dtypes, sizes=sizes,
         group_sizes=args.groups, iters=args.iters, rounds=args.rounds,
-        verbose=not args.quiet)
+        link_class=args.link_class, verbose=not args.quiet)
     if not measurements:
         print("probe produced no measurements", file=sys.stderr)
         return 2
+    if simulate_dcn is not None:
+        alpha, beta = simulate_dcn
+        measurements += simulate_link_measurements(
+            alpha, beta, link_class="dcn", ops=ops, dtypes=["f32"],
+            sizes=sizes, group_sizes=args.groups or (2, 4))
 
     if args.holdout:
         train, held = holdout_split(measurements, every=args.holdout)
@@ -113,10 +143,13 @@ def main(argv=None):
     })
     model.save(args.out, measurements=measurements)
 
-    print(f"wrote {args.out}: {len(model.fits)} fitted curves over "
-          f"{len(train)} points")
-    for (op, dtype), fit in sorted(model.fits.items()):
-        print(f"  {op:<13} {dtype:<5} alpha={fit.alpha_s * 1e6:8.2f}us/hop"
+    curves = model.curves()
+    print(f"wrote {args.out}: {len(curves)} fitted curves over "
+          f"{len(train)} points "
+          f"(link classes: {', '.join(model.link_classes)})")
+    for (op, dtype, lc), fit in sorted(curves.items()):
+        print(f"  {op:<13} {dtype:<5} {lc:<4} "
+              f"alpha={fit.alpha_s * 1e6:8.2f}us/hop"
               f"  beta={fit.beta_s_per_byte * 1e9:8.3f}ns/B"
               f"  fit_err<={fit.max_rel_err:.2f}")
     if held:
