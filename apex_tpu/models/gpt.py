@@ -28,7 +28,9 @@ from apex_tpu.normalization import MixedFusedLayerNorm
 from apex_tpu.ops.flash_attention import (flash_attention,
                                           flash_attention_chunk_paged,
                                           flash_attention_decode,
-                                          flash_attention_decode_paged)
+                                          flash_attention_decode_paged,
+                                          flash_attention_decode_paged_quant,
+                                          quantize_kv_blocks)
 from apex_tpu.ops.rope import (fused_apply_rotary_pos_emb_at_positions,
                                fused_apply_rotary_pos_emb_cached, rope_freqs)
 from apex_tpu.transformer import tensor_parallel as tp
@@ -362,6 +364,105 @@ class ParallelAttention:
         out, _ = self.proj(params["proj"], ctx)
         return out, pool
 
+    def _quant_insert(self, pool, scales, layer_index, bids, offs, k, v):
+        """Write one token's K/V into an int8 pool: gather each row's
+        target block, dequantize it, insert, and requantize the WHOLE
+        block (safe and deterministic because quantized blocks are
+        zeroed on allocation and shared blocks are never write targets
+        — COW and the trie guarantee refcount 1 here).  Returns the
+        updated ``(pool, scales)``."""
+        rows = jnp.arange(bids.shape[0])
+        blk = pool[bids, layer_index]            # (b, 2, bs, nh, hd) i8
+        sc = scales[bids, layer_index]           # (b, 2, nh) f32
+        deq = blk.astype(jnp.float32) * sc[..., None, :, None]
+        deq = deq.at[rows, 0, offs].set(k.astype(jnp.float32))
+        deq = deq.at[rows, 1, offs].set(v.astype(jnp.float32))
+        q8, new_sc = quantize_kv_blocks(deq)
+        pool = pool.at[bids, layer_index].set(q8)
+        scales = scales.at[bids, layer_index].set(new_sc)
+        return pool, scales
+
+    def decode_paged_quant(self, params, x, pool, scales, layer_index,
+                           block_tables, positions):
+        """:meth:`decode_paged` against an int8 scale-per-block pool
+        (``pool`` int8, ``scales`` ``(num_blocks, layers, 2, kv_heads)``
+        f32).  The written block is dequantized, updated, and
+        requantized; attention dequantizes per gathered block into the
+        f32 score path.  Returns ``(out, pool, scales)``."""
+        cfg = self.cfg
+        b = x.shape[0]
+        bs = pool.shape[3]
+        q, k, v = self._qkv(params, x)           # (b, 1, nh, hd)
+        q, k, v = q[:, 0], k[:, 0], v[:, 0]      # (b, nh, hd)
+        if cfg.rotary:
+            f = rope_freqs(block_tables.shape[1] * bs, cfg.head_dim)
+            q = fused_apply_rotary_pos_emb_at_positions(
+                q, jnp.cos(f), jnp.sin(f), positions)
+            k = fused_apply_rotary_pos_emb_at_positions(
+                k, jnp.cos(f), jnp.sin(f), positions)
+        bids = block_tables[jnp.arange(b), positions // bs]
+        pool, scales = self._quant_insert(pool, scales, layer_index,
+                                          bids, positions % bs, k, v)
+        ctx = flash_attention_decode_paged_quant(
+            q, pool[:, layer_index, 0], pool[:, layer_index, 1],
+            scales[:, layer_index, 0], scales[:, layer_index, 1],
+            block_tables, positions + 1)
+        out, _ = self.proj(params["proj"],
+                           ctx.reshape(b, 1, q.shape[1] * cfg.head_dim))
+        return out, pool, scales
+
+    def decode_chunk_quant(self, params, x, pool, scales, layer_index,
+                           block_tables, positions, write_blocks,
+                           write_offsets):
+        """:meth:`decode_chunk` against an int8 pool.
+
+        Tokens are inserted (and their block requantized) SEQUENTIALLY,
+        each attending right after its own insertion — exactly the
+        single-token :meth:`decode_paged_quant` block op applied
+        ``chunk`` times under one shared QKV projection.  That
+        serialization is what makes the quantized pool state (and every
+        logits row) a fold over per-token ops, independent of how the
+        scheduler sliced the prompt into chunks — the property the
+        disaggregated handoff's bitwise guarantee rests on.  The cost is
+        a ``fori_loop`` over the chunk instead of one wide attention;
+        the quantized cache trades prefill throughput for capacity.
+        """
+        cfg = self.cfg
+        b, c = x.shape[:2]
+        q, k, v = self._qkv(params, x)           # (b, c, nh, hd)
+        nh = q.shape[2]
+        if cfg.rotary:
+            f = rope_freqs(block_tables.shape[1] * pool.shape[3],
+                           cfg.head_dim)
+            cos, sin = jnp.cos(f), jnp.sin(f)
+            flat = positions.reshape(-1)
+            q = fused_apply_rotary_pos_emb_at_positions(
+                q.reshape(b * c, nh, cfg.head_dim), cos, sin, flat
+            ).reshape(b, c, nh, cfg.head_dim)
+            k = fused_apply_rotary_pos_emb_at_positions(
+                k.reshape(b * c, nh, cfg.head_dim), cos, sin, flat
+            ).reshape(b, c, nh, cfg.head_dim)
+
+        def body(j, carry):
+            pool, scales, ctx = carry
+            bids = write_blocks[:, j]
+            pool, scales = self._quant_insert(
+                pool, scales, layer_index, bids, write_offsets[:, j],
+                k[:, j], v[:, j])
+            o = flash_attention_decode_paged_quant(
+                q[:, j], pool[:, layer_index, 0],
+                pool[:, layer_index, 1], scales[:, layer_index, 0],
+                scales[:, layer_index, 1], block_tables,
+                positions[:, j] + 1)
+            return pool, scales, ctx.at[:, j].set(o)
+
+        ctx0 = jnp.zeros((b, c, nh, cfg.head_dim), q.dtype)
+        pool, scales, ctx = jax.lax.fori_loop(0, c, body,
+                                              (pool, scales, ctx0))
+        out, _ = self.proj(params["proj"],
+                           ctx.reshape(b, c, nh * cfg.head_dim))
+        return out, pool, scales
+
 
 class ParallelMLP:
     """Column→GELU→Row block (apex ParallelMLP)."""
@@ -532,6 +633,39 @@ class ParallelTransformerLayer:
         if self.is_moe:
             y, _ = y
         return x + y, pool
+
+    def decode_paged_quant(self, params, x, pool, scales, layer_index,
+                           block_tables, positions):
+        """Int8-pool analog of :meth:`decode_paged`; see
+        :meth:`ParallelAttention.decode_paged_quant`."""
+        h = self.input_layernorm(params["input_layernorm"], x)
+        attn, pool, scales = self.attention.decode_paged_quant(
+            params["attention"], h, pool, scales, layer_index,
+            block_tables, positions)
+        x = x + attn
+        h = self.post_attention_layernorm(
+            params["post_attention_layernorm"], x)
+        y = self.mlp(params["mlp"], h)
+        if self.is_moe:
+            y, _ = y
+        return x + y, pool, scales
+
+    def decode_chunk_quant(self, params, x, pool, scales, layer_index,
+                           block_tables, positions, write_blocks,
+                           write_offsets):
+        """Int8-pool analog of :meth:`decode_chunk`; see
+        :meth:`ParallelAttention.decode_chunk_quant`."""
+        h = self.input_layernorm(params["input_layernorm"], x)
+        attn, pool, scales = self.attention.decode_chunk_quant(
+            params["attention"], h, pool, scales, layer_index,
+            block_tables, positions, write_blocks, write_offsets)
+        x = x + attn
+        h = self.post_attention_layernorm(
+            params["post_attention_layernorm"], x)
+        y = self.mlp(params["mlp"], h)
+        if self.is_moe:
+            y, _ = y
+        return x + y, pool, scales
 
 
 class GPTModel:
@@ -830,6 +964,51 @@ class GPTModel:
                                          positions, write_blocks,
                                          write_offsets)
         return self.logits(params, x), pool
+
+    def decode_step_paged_quant(self, params, tokens, pool, scales,
+                                block_tables, positions):
+        """:meth:`decode_step_paged` against an int8 scale-per-block
+        pool (``pool`` int8 of the same shape, ``scales``
+        ``(num_blocks, layers, 2, kv_heads)`` f32; see
+        :class:`apex_tpu.serving.QuantizedPagedKVCache`).  Same embed,
+        RoPE rows, and f32 head einsum — the only difference is the
+        per-block dequantize/requantize around the cache access.
+        Returns ``(logits, pool, scales)``."""
+        self._check_decode_supported()
+        x = self.embedding(params["embedding"], tokens[:, None])
+        if not self.cfg.rotary:
+            x = x + params["position_embedding"][positions][:, None]
+        x = x.astype(self.cfg.dtype)
+        for li, (layer, lp) in enumerate(zip(self.layers,
+                                             params["layers"])):
+            x, pool, scales = layer.decode_paged_quant(
+                lp, x, pool, scales, li, block_tables, positions)
+        x = self.final_layernorm(params["final_layernorm"], x)
+        w = params["embedding"]["weight"]
+        logits = jnp.einsum("bh,vh->bv", x[:, 0].astype(_f32),
+                            w.astype(_f32))
+        return logits, pool, scales
+
+    def decode_chunk_quant(self, params, tokens, pool, scales,
+                           block_tables, positions, write_blocks,
+                           write_offsets):
+        """:meth:`decode_chunk` against an int8 pool — chunked prefill
+        on a quantized cache.  Inserts are serialized per token inside
+        each layer (see
+        :meth:`ParallelAttention.decode_chunk_quant`), which keeps the
+        final pool state independent of chunk boundaries.  Returns
+        ``(logits, pool, scales)``."""
+        self._check_decode_supported()
+        x = self.embedding(params["embedding"], tokens)
+        if not self.cfg.rotary:
+            x = x + params["position_embedding"][positions]
+        x = x.astype(self.cfg.dtype)
+        for li, (layer, lp) in enumerate(zip(self.layers,
+                                             params["layers"])):
+            x, pool, scales = layer.decode_chunk_quant(
+                lp, x, pool, scales, li, block_tables, positions,
+                write_blocks, write_offsets)
+        return self.logits(params, x), pool, scales
 
     def loss(self, params, tokens, targets, dropout_seed=None):
         """Mean next-token loss via vocab-parallel cross entropy (+ the

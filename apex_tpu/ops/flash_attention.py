@@ -867,6 +867,103 @@ def flash_attention_chunk_paged(q, k_pool, v_pool, block_tables,
     return o.astype(q.dtype)
 
 
+def quantize_kv_blocks(blocks):
+    """Int8 scale-per-block quantization of KV blocks (the EQuARX idiom
+    from ``utils.compressed_allreduce``, applied to the paged cache).
+
+    ``blocks``: ``(..., block_size, heads, head_dim)`` float — any
+    leading batch/layer/kv axes.  The scale is shared across the block's
+    positions and head_dim but kept PER HEAD (attention scores are
+    per-head dot products, so a hot head cannot inflate a cold head's
+    quantization step).  Returns ``(q8, scales)`` with ``q8`` int8 of
+    ``blocks.shape`` and ``scales`` f32 of ``blocks.shape[:-3] +
+    (heads,)``.  All-zero blocks get scale 1.0, so dequantization is
+    exact zeros — the zero-on-alloc invariant the quantized pool relies
+    on for deterministic whole-block requantization.
+    """
+    x = blocks.astype(_f32)
+    amax = jnp.max(jnp.abs(x), axis=(-3, -1))        # (..., heads)
+    scale = amax / 127.0
+    scale = jnp.where(scale > 0, scale, 1.0)
+    q8 = jnp.clip(jnp.round(x / scale[..., None, :, None]),
+                  -127, 127).astype(jnp.int8)
+    return q8, scale
+
+
+def dequantize_kv_blocks(q8, scales, dtype=jnp.float32):
+    """Inverse of :func:`quantize_kv_blocks`: ``q8``
+    ``(..., block_size, heads, head_dim)`` int8, ``scales``
+    ``(..., heads)`` f32, returns ``dtype``."""
+    return (q8.astype(_f32) * scales[..., None, :, None]).astype(dtype)
+
+
+def gather_paged_kv_quant(pool, scales, block_tables,
+                          dtype=jnp.float32):
+    """:func:`gather_paged_kv` for an int8 pool: gather the table's
+    blocks AND their per-block scales, dequantize only what was
+    gathered, and return the contiguous layout in ``dtype``.
+
+    ``pool``: ``(num_blocks, block_size, heads, head_dim)`` int8 (one
+    layer, one of K/V); ``scales``: ``(num_blocks, heads)`` f32;
+    ``block_tables``: ``(batch, max_blocks)`` int.  Returns
+    ``(batch, max_blocks * block_size, heads, head_dim)``.
+    """
+    b, nb = block_tables.shape
+    bs, h, d = pool.shape[1:]
+    deq = dequantize_kv_blocks(pool[block_tables],
+                               scales[block_tables], dtype)
+    return deq.reshape(b, nb * bs, h, d)
+
+
+def flash_attention_decode_paged_quant(q, k_pool, v_pool, k_scales,
+                                       v_scales, block_tables,
+                                       cache_lens, softmax_scale=None):
+    """Single-token decode attention over an int8 paged pool.
+
+    Same contract as :func:`flash_attention_decode_paged` with the pool
+    quantized: ``k_pool``/``v_pool`` int8, ``k_scales``/``v_scales``
+    ``(num_blocks, heads)`` f32.  Dequantization rides the gather path —
+    only the table's blocks are dequantized (into f32, the same
+    precision the reference's scores/PV already accumulate in), then the
+    masked reference runs unchanged, so the quantized decode differs
+    from the bf16/f32 decode ONLY by the per-block rounding, never by
+    schedule.  A fused Pallas kernel that dequantizes in-VMEM per block
+    is a straightforward extension of ``_decode_paged_kernel`` (the
+    scale is one scalar per (block, head)); the gather path keeps CI
+    exact and backend-uniform.
+    """
+    cache_lens = cache_lens.astype(jnp.int32)
+    block_tables = block_tables.astype(jnp.int32)
+    scale = float(softmax_scale if softmax_scale is not None
+                  else q.shape[-1] ** -0.5)
+    return flash_attention_decode_reference(
+        q, gather_paged_kv_quant(k_pool, k_scales, block_tables, _f32),
+        gather_paged_kv_quant(v_pool, v_scales, block_tables, _f32),
+        cache_lens, scale)
+
+
+def flash_attention_chunk_paged_quant(q, k_pool, v_pool, k_scales,
+                                      v_scales, block_tables,
+                                      q_positions, softmax_scale=None):
+    """Multi-query decode attention over an int8 paged pool — the
+    quantized :func:`flash_attention_chunk_paged` (chunked prefill on a
+    quantized cache).  Same masked-gather math with the gather
+    dequantizing per block."""
+    b, h, c, d = q.shape
+    scale = softmax_scale if softmax_scale is not None else d ** -0.5
+    k = gather_paged_kv_quant(k_pool, k_scales, block_tables, _f32)
+    v = gather_paged_kv_quant(v_pool, v_scales, block_tables, _f32)
+    S = k.shape[1]
+    s = jnp.einsum("bhcd,bshd->bhcs", q.astype(_f32), k) * scale
+    valid = (jnp.arange(S)[None, None, None, :]
+             <= q_positions[:, None, :, None])    # (b, 1, c, S)
+    s = jnp.where(valid, s, _MASK)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(valid, p, 0.0)
+    o = jnp.einsum("bhcs,bshd->bhcd", p, v)
+    return o.astype(q.dtype)
+
+
 def flash_attention(q, k, v, causal=False, softmax_scale=None,
                     kv_seqlens=None, block_q=1024, block_k=1024,
                     dropout=0.0, dropout_seed=None):

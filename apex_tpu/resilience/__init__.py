@@ -33,6 +33,7 @@ Four layers, composable but independently usable:
 from apex_tpu.resilience.capacity import (CAPACITY_FAULT_MODES,
                                           CapacityBudget,
                                           CapacityController,
+                                          PoolCapacityController,
                                           ReshardFailed, fault_mode)
 from apex_tpu.resilience.checkpoint import (CheckpointManager,
                                             CheckpointNotFound)
@@ -50,6 +51,7 @@ __all__ = [
     "CAPACITY_FAULT_MODES",
     "CapacityBudget",
     "CapacityController",
+    "PoolCapacityController",
     "ReshardFailed",
     "fault_mode",
     "CheckpointManager",

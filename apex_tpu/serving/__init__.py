@@ -9,9 +9,18 @@ What :mod:`apex_tpu.inference` leaves on the table, this package takes:
   the block pool, token-bitwise-identical to the contiguous engine,
   with chunked prefill (:class:`TickScheduler` budgets) and
   exact-match speculative decoding (:class:`SpeculativeConfig`).
+* :class:`QuantizedPagedKVCache` — the int8 scale-per-block variant:
+  ~4× the concurrent users per byte of KV at a pinned numeric
+  tolerance (greedy streams agree on the CI configs), prefix sharing
+  and copy-on-write preserved.
 * :class:`Router` — SLO-burn-aware multi-replica admission with
   explicit shedding (:class:`RequestShed` + :class:`ShedReason` +
   ``retry_after_s``).
+* :mod:`apex_tpu.serving.disagg` — disaggregated prefill/decode
+  serving: :class:`DisaggregatedFleet` fronts a prefill pool and a
+  decode pool, shipping each request's KV blocks across an explicit
+  priced :class:`KvChannel` (``export_kv``/``adopt_kv``,
+  token-bitwise, re-prefill fallback on a lost handoff).
 * :mod:`apex_tpu.serving.fleet` — fault tolerance: deterministic
   replica fault injection (:class:`ServingFaultInjector`), the
   health-checked :class:`FleetRouter` (retry/backoff, hedging,
@@ -25,19 +34,25 @@ traffic (and, with ``--scenario``, under chaos workloads) and reports
 TTFT/TPOT/e2e percentiles with per-outcome counts.
 """
 
-from apex_tpu.serving.engine import PagedInferenceEngine
+from apex_tpu.serving.disagg import DisaggregatedFleet, KvChannel
+from apex_tpu.serving.engine import KvHandoff, PagedInferenceEngine
 from apex_tpu.serving.fleet import (SERVING_FAULT_KINDS, DegradationLadder,
                                     FleetRouter, ReplicaHealth, ServingFault,
                                     ServingFaultInjector, VirtualClock)
-from apex_tpu.serving.paged_kv import PagedKVCache, PagedSequence
+from apex_tpu.serving.paged_kv import (PagedKVCache, PagedSequence,
+                                       QuantizedPagedKVCache)
 from apex_tpu.serving.router import RequestShed, Router, ShedReason
 from apex_tpu.serving.scheduler import TickPlan, TickScheduler
 from apex_tpu.serving.speculative import SpeculativeConfig
 
 __all__ = [
+    "DisaggregatedFleet",
+    "KvChannel",
+    "KvHandoff",
     "PagedInferenceEngine",
     "PagedKVCache",
     "PagedSequence",
+    "QuantizedPagedKVCache",
     "RequestShed",
     "Router",
     "ShedReason",

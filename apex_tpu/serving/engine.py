@@ -46,11 +46,43 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from apex_tpu.inference.engine import InferenceEngine, _Active
+from apex_tpu.inference.engine import (InferenceEngine, QueueFull, Request,
+                                       _Active)
 from apex_tpu.inference.kv_cache import KVCache
-from apex_tpu.serving.paged_kv import PagedKVCache
+from apex_tpu.serving.paged_kv import PagedKVCache, QuantizedPagedKVCache
 from apex_tpu.serving.scheduler import TickScheduler
 from apex_tpu.serving.speculative import SpeculativeConfig
+
+
+@dataclasses.dataclass
+class KvHandoff:
+    """A request's KV state in flight between engines — what
+    :meth:`PagedInferenceEngine.export_kv` produces and
+    :meth:`PagedInferenceEngine.adopt_kv` installs (the disaggregated
+    prefill→decode handoff; see :mod:`apex_tpu.serving.disagg`).
+
+    ``payload`` is the exporting pool's raw block storage
+    (:meth:`PagedKVCache.export_blocks` — ``data``, plus ``scales`` for
+    the int8 pool), ``kv_tokens`` the ``kv_len`` tokens it backs
+    (``prompt + generated[:-1]`` — ``generated[-1]`` is the next token
+    to FEED, whose KV the first decode step writes), and ``kind`` /
+    ``block_size`` the storage-compatibility tags the adopting pool
+    must match for a bitwise install."""
+    request: Request
+    generated: List[int]
+    kv_len: int
+    kv_tokens: List[int]
+    payload: dict
+    block_size: int
+    kind: str
+    src_replica: int = -1
+
+    def nbytes(self) -> int:
+        """Bytes the handoff moves over the wire (block storage only;
+        the request metadata is negligible and identical across cache
+        kinds)."""
+        return int(sum(np.asarray(v).nbytes
+                       for v in self.payload.values()))
 
 
 @dataclasses.dataclass
@@ -73,11 +105,36 @@ class PagedInferenceEngine(InferenceEngine):
                  chunked_prefill: bool = False,
                  scheduler: Optional[TickScheduler] = None,
                  speculative: Optional[SpeculativeConfig] = None,
+                 kv_quant: Optional[str] = None,
+                 prefill_only: bool = False,
                  **kw):
+        if kv_quant not in (None, "int8"):
+            raise ValueError(f"kv_quant must be None or 'int8', "
+                             f"got {kv_quant!r}")
+        if kv_quant is not None and speculative is not None:
+            raise ValueError(
+                "kv_quant is incompatible with speculative decoding: "
+                "the verify chunk consumes INTERMEDIATE chunk logits, "
+                "which later proposals' block requantization perturbs — "
+                "only the final row of a quantized chunk is "
+                "schedule-invariant")
+        if kv_quant is not None and not chunked_prefill:
+            raise ValueError(
+                "kv_quant requires chunked_prefill=True: the chunked "
+                "path appends+requantizes per token, which is what "
+                "makes re-prefill (migration/preemption resume) bitwise "
+                "on a quantized cache; monolithic prefill quantizes "
+                "each block one-shot and cannot replay decode's "
+                "per-token history")
+        if prefill_only and not chunked_prefill:
+            raise ValueError("prefill_only requires chunked_prefill=True "
+                             "(prefill replicas run chunked prefill only)")
         self._block_size = block_size
         self._num_blocks = num_blocks
         self._share_prefixes = share_prefixes
         self.chunked_prefill = chunked_prefill
+        self.kv_quant = kv_quant
+        self.prefill_only = prefill_only
         self.scheduler = scheduler or TickScheduler()
         self.spec = speculative
         # runtime switch over the configured spec path: the fleet's
@@ -111,7 +168,9 @@ class PagedInferenceEngine(InferenceEngine):
             # as roomy as the contiguous ring it replaces (+ garbage
             # block); real deployments size this to HBM, not to slots
             self._num_blocks = 1 + max_slots * self.max_blocks
-        self.pool = PagedKVCache(
+        pool_cls = (QuantizedPagedKVCache if self.kv_quant == "int8"
+                    else PagedKVCache)
+        self.pool = pool_cls(
             self._num_blocks, bs, cfg.num_layers, cfg.local_heads,
             cfg.head_dim, cache_dtype, share_prefixes=self._share_prefixes,
             registry=self.metrics.registry)
@@ -120,11 +179,19 @@ class PagedInferenceEngine(InferenceEngine):
         self._tables = np.zeros((max_slots, self.max_blocks), np.int32)
         self._prefilling: dict = {}      # slot -> _ChunkPrefill
         self._prefill_order: List[int] = []
+        self._handoff_ready: List[int] = []   # parked prefill_only slots
         self._admit_stamp: dict = {}     # slot -> admission counter
         self._admitted = 0
-        self._decode_paged = jax.jit(self.model.decode_step_paged,
-                                     donate_argnums=(2,))
-        self._chunk = jax.jit(self.model.decode_chunk, donate_argnums=(2,))
+        if self.kv_quant == "int8":
+            self._decode_paged_q = jax.jit(
+                self.model.decode_step_paged_quant, donate_argnums=(2, 3))
+            self._chunk_q = jax.jit(self.model.decode_chunk_quant,
+                                    donate_argnums=(2, 3))
+        else:
+            self._decode_paged = jax.jit(self.model.decode_step_paged,
+                                         donate_argnums=(2,))
+            self._chunk = jax.jit(self.model.decode_chunk,
+                                  donate_argnums=(2,))
         self._prefill = jax.jit(self.model.prefill)
         if self.spec is not None:
             self.spec.validate_against(self.model)
@@ -156,6 +223,8 @@ class PagedInferenceEngine(InferenceEngine):
         self._prefilling.pop(slot, None)
         if slot in self._prefill_order:
             self._prefill_order.remove(slot)
+        if slot in self._handoff_ready:
+            self._handoff_ready.remove(slot)
         self._admit_stamp.pop(slot, None)
         self._free_slots.append(slot)
 
@@ -262,7 +331,9 @@ class PagedInferenceEngine(InferenceEngine):
         self._export_cache_gauges()
         if not self._active:
             return bool(self._queue)
-        decoding = [s for s in self._active if s not in self._prefilling]
+        decoding = [s for s in self._active
+                    if s not in self._prefilling
+                    and s not in self._handoff_ready]
         if self._prefilling:
             plan = self.scheduler.plan(
                 len(decoding),
@@ -274,7 +345,8 @@ class PagedInferenceEngine(InferenceEngine):
                 if slot in self._prefilling:     # may have been evicted
                     self._run_prefill_chunk(slot, n)
         decoding = sorted(s for s in self._active
-                          if s not in self._prefilling)
+                          if s not in self._prefilling
+                          and s not in self._handoff_ready)
         if decoding:
             if self._spec_active:
                 self._spec_round(decoding)
@@ -297,9 +369,16 @@ class PagedInferenceEngine(InferenceEngine):
             st = self._active[slot]
             tokens[slot] = st.next_token
             positions[slot] = st.position
-        logits, self.pool.data = self._decode_paged(
-            self.params, jnp.asarray(tokens), self.pool.data,
-            jnp.asarray(self._tables), jnp.asarray(positions))
+        if self.kv_quant == "int8":
+            logits, self.pool.data, self.pool.scales = \
+                self._decode_paged_q(
+                    self.params, jnp.asarray(tokens), self.pool.data,
+                    self.pool.scales, jnp.asarray(self._tables),
+                    jnp.asarray(positions))
+        else:
+            logits, self.pool.data = self._decode_paged(
+                self.params, jnp.asarray(tokens), self.pool.data,
+                jnp.asarray(self._tables), jnp.asarray(positions))
         self.metrics.step(len(decoding), n)
         self._advance_slots(decoding, np.asarray(logits))
 
@@ -325,10 +404,17 @@ class PagedInferenceEngine(InferenceEngine):
             wb[0, j] = seq.block_ids[p // bs]
             wo[0, j] = p % bs
         try:
-            logits, self.pool.data = self._chunk(
-                self.params, jnp.asarray(toks), self.pool.data,
-                jnp.asarray(self._tables[slot:slot + 1]),
-                jnp.asarray(pos), jnp.asarray(wb), jnp.asarray(wo))
+            if self.kv_quant == "int8":
+                logits, self.pool.data, self.pool.scales = self._chunk_q(
+                    self.params, jnp.asarray(toks), self.pool.data,
+                    self.pool.scales,
+                    jnp.asarray(self._tables[slot:slot + 1]),
+                    jnp.asarray(pos), jnp.asarray(wb), jnp.asarray(wo))
+            else:
+                logits, self.pool.data = self._chunk(
+                    self.params, jnp.asarray(toks), self.pool.data,
+                    jnp.asarray(self._tables[slot:slot + 1]),
+                    jnp.asarray(pos), jnp.asarray(wb), jnp.asarray(wo))
             cs.done = end
             if end < len(cs.ctx):
                 return
@@ -352,7 +438,129 @@ class PagedInferenceEngine(InferenceEngine):
         st.generated.append(nxt)
         del self._prefilling[slot]
         self._prefill_order.remove(slot)
+        if not self._maybe_finish(slot, st) and self.prefill_only:
+            # disaggregated prefill replica: the request is done with
+            # its prefill phase — park it (no decode steps here) until
+            # the fleet ships its KV to a decode replica via export_kv
+            self._handoff_ready.append(slot)
+
+    # -- disaggregated KV handoff ----------------------------------------------
+
+    def handoffs_ready(self) -> List[tuple]:
+        """``(slot, request_id)`` pairs parked after a completed prefill
+        on a ``prefill_only`` engine, ascending slot — the export queue
+        the disaggregated fleet drains each tick."""
+        return [(s, self._active[s].request.request_id)
+                for s in sorted(self._handoff_ready)]
+
+    def export_kv(self, request_id) -> KvHandoff:
+        """Strip ``request_id`` off this engine WITH its KV blocks — the
+        block-shipping generalization of :meth:`export_inflight`.  The
+        returned :class:`KvHandoff` carries the raw storage of every
+        block backing ``kv_len = position`` valid positions (i.e. the KV
+        of ``prompt + generated[:-1]``; ``generated[-1]`` is the next
+        token to feed, whose KV the adopting engine's first decode step
+        writes), so :meth:`adopt_kv` resumes WITHOUT re-running prefill
+        — bitwise, because paged attention only ever gathers the block
+        storage this payload is a literal copy of.  Terminal on this
+        engine like a migration: reason ``"migrated"``, no Response.
+        Raises KeyError when the id is not active here and ValueError
+        while its prefill is still chunking (no complete KV to ship —
+        let it finish or fall back to :meth:`export_inflight`)."""
+        slot = next((s for s, st in self._active.items()
+                     if st.request.request_id == request_id), None)
+        if slot is None:
+            raise KeyError(f"request {request_id!r} is not active on "
+                           "this engine")
+        if slot in self._prefilling:
+            raise ValueError(
+                f"request {request_id!r} is mid-prefill; its KV is "
+                "incomplete — export_inflight() re-prefills instead")
+        st = self._active[slot]
+        seq = self._seqs[slot]
+        kv_len = st.position
+        ids = seq.block_ids[:self.pool.blocks_for(kv_len)]
+        handoff = KvHandoff(
+            request=st.request,
+            generated=list(st.generated),
+            kv_len=kv_len,
+            kv_tokens=list(st.request.prompt) + list(st.generated[:-1]),
+            payload=self.pool.export_blocks(ids),
+            block_size=self.pool.block_size,
+            kind=self.pool.kind)
+        st = self._active.pop(slot)
+        self._release(slot, st)
+        rid = st.request.request_id
+        self._submit_time.pop(rid, None)
+        self._progress.pop(rid, None)
+        self.metrics.request_migrated(rid)
+        self.trace.finish(rid, "migrated")
+        return handoff
+
+    def adopt_kv(self, handoff: KvHandoff) -> int:
+        """Install a shipped-KV request: acquire blocks for its
+        ``kv_tokens``, copy the payload into them, and resume decode at
+        ``position = kv_len`` feeding ``generated[-1]`` — no re-prefill.
+        Storage tags must match (``kind``, ``block_size``): a bitwise
+        install is a literal block copy, so bf16→int8 (or mismatched
+        block geometry) must go through the re-prefill fallback
+        (:meth:`~apex_tpu.inference.InferenceEngine.adopt`) instead.
+        Admission is immediate (no queue pass): raises
+        :class:`QueueFull` when no slot or no blocks are available so
+        the fleet can retry or fall back, ValueError on tag/context
+        misfit.  Returns the slot."""
+        req = handoff.request
+        if handoff.kind != self.pool.kind:
+            raise ValueError(
+                f"handoff cache kind {handoff.kind!r} does not match "
+                f"this pool ({self.pool.kind!r}); re-prefill via adopt()")
+        if handoff.block_size != self.pool.block_size:
+            raise ValueError(
+                f"handoff block_size {handoff.block_size} does not "
+                f"match this pool ({self.pool.block_size})")
+        if len(req.prompt) + len(handoff.generated) >= self.max_seq:
+            raise ValueError(
+                f"context {len(req.prompt)} + {len(handoff.generated)} "
+                f"does not fit max_seq={self.max_seq}; finish with "
+                "reason='preempted' instead of adopting")
+        self._validate(req)
+        if "reject_admission" in self.injected_faults:
+            raise QueueFull("injected fault: admission rejected at this "
+                            "replica")
+        if not self._free_slots:
+            raise QueueFull("no free decode slot for the KV handoff; "
+                            "retry after step() completes one")
+        seq = self.pool.acquire(handoff.kv_tokens)
+        if seq is None:
+            raise QueueFull("no free blocks for the KV handoff; retry "
+                            "after decode completions release some")
+        # trie-shared prefix blocks already hold bitwise-identical KV
+        # (published by an earlier adopt of the same prefix), so the
+        # payload rows are only copied for the fresh tail
+        start = seq.shared_tokens // self.pool.block_size
+        self.pool.import_blocks(
+            seq.block_ids[start:],
+            {k: v[start:] for k, v in handoff.payload.items()})
+        self.pool.register_prefix(seq, handoff.kv_tokens)
+        slot = self._free_slots.pop()
+        self._admitted += 1
+        self._admit_stamp[slot] = self._admitted
+        self._seqs[slot] = seq
+        self._tables[slot] = self.pool.table_row(seq, self.max_blocks)
+        rid = req.request_id
+        self._submit_time[rid] = self.clock()
+        self.metrics.request_submitted(rid)
+        self.trace.enqueue(rid, ctx=req.trace)
+        self.trace.admit(rid)
+        self.trace.resumed(rid)
+        self._draft_admit(slot, handoff.kv_tokens)
+        st = _Active(req, len(req.prompt),
+                     next_token=handoff.generated[-1],
+                     position=handoff.kv_len,
+                     generated=list(handoff.generated))
+        self._active[slot] = st
         self._maybe_finish(slot, st)
+        return slot
 
     # -- speculative decoding ------------------------------------------------
 

@@ -253,12 +253,22 @@ class DegradationLadder:
     immediate; de-escalation steps down ONE level after ``step_down_s``
     of burn below the current level's entry threshold (hysteresis — a
     ladder that flaps is worse than one that is a little sticky).
+
+    ``burn_source`` overrides WHICH burn drives the ladder: by default
+    the owning router feeds it the max burn across its own replicas,
+    which is right for a homogeneous fleet but wrong for a
+    disaggregated one — level 2's actions (prefix flush + context cap)
+    relieve *decode* KV pressure, so a prefill-pool TTFT burn must not
+    trigger them.  :class:`~apex_tpu.serving.disagg.DisaggregatedFleet`
+    threads the decode pool's burn through here so every router sharing
+    the ladder degrades on the signal the actions actually act on.
     """
 
     LEVELS = ("normal", "no_spec", "shrink_context", "shed")
 
     def __init__(self, thresholds: Sequence[float] = (2.0, 6.0, 14.4), *,
-                 step_down_s: float = 1.0, ctx_cap_frac: float = 0.5):
+                 step_down_s: float = 1.0, ctx_cap_frac: float = 0.5,
+                 burn_source=None):
         if len(thresholds) != 3 or list(thresholds) != sorted(thresholds):
             raise ValueError("need 3 ascending burn thresholds")
         if not 0.0 < ctx_cap_frac <= 1.0:
@@ -266,6 +276,7 @@ class DegradationLadder:
         self.thresholds = tuple(float(t) for t in thresholds)
         self.step_down_s = float(step_down_s)
         self.ctx_cap_frac = float(ctx_cap_frac)
+        self.burn_source = burn_source
         self.level = 0
         self._calm_since: Optional[float] = None
 
@@ -920,7 +931,12 @@ class FleetRouter(Router):
         live = self._live()
         if not live:
             return
-        burn = max(self._burn(e) for _, e in live)
+        if self.ladder.burn_source is not None:
+            # per-pool signal (disaggregation): degrade on the pool
+            # whose pressure the ladder's actions actually relieve
+            burn = float(self.ladder.burn_source())
+        else:
+            burn = max(self._burn(e) for _, e in live)
         old = self.ladder.level
         lvl = self.ladder.update(burn, self.clock())
         if lvl == old:

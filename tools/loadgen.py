@@ -56,7 +56,20 @@ SLO burn are deterministic on any host:
   live :class:`~apex_tpu.resilience.elastic.ElasticTrainer` under a
   burn-driven :class:`~apex_tpu.resilience.capacity.CapacityController`
   (delegates to ``tools/day_in_life.py``, which owns the training side
-  and the hard gates).
+  and the hard gates);
+* ``disagg_diurnal`` — a mixed day against a
+  :class:`~apex_tpu.serving.DisaggregatedFleet`: a prefill-heavy
+  morning (long prompts, short generations) flips mid-day into a
+  decode-heavy afternoon (short prompts, long generations), and a
+  :class:`~apex_tpu.resilience.capacity.PoolCapacityController` moves
+  a replica prefill→decode at the flip; GATES on the exactly-once
+  ledger, per-phase SLO attainment ≥ 0.9, and a clean capacity audit;
+* ``disagg_longctx_fair`` — multi-tenant fairness on the same
+  disaggregated stack: one tenant submits near-context-limit prompts
+  while the others run short interactive traffic; GATES on the
+  exactly-once ledger and per-TENANT SLO attainment ≥ 0.9 — the
+  long-context tenant must not starve the short ones of first tokens
+  (that isolation is the point of a separate prefill pool).
 
 Every scenario report carries the exactly-once ledger (``submitted`` /
 ``lost`` / ``duplicated``), per-outcome counts, SLO attainment over the
@@ -87,7 +100,9 @@ import jax            # noqa: E402
 import numpy as np    # noqa: E402
 
 SCENARIOS = ("steady", "replica_kill", "slow_replica", "diurnal", "bursty",
-             "capacity_diurnal")
+             "capacity_diurnal", "disagg_diurnal", "disagg_longctx_fair")
+
+DISAGG_SCENARIOS = ("disagg_diurnal", "disagg_longctx_fair")
 
 
 def _pct(xs, q):
@@ -460,6 +475,237 @@ def run_scenario(args) -> dict:
     }
 
 
+# -- disaggregated scenarios --------------------------------------------------
+
+
+def build_disagg_fleet(args, clock):
+    """(fleet, controller): a 2-pool DisaggregatedFleet (prefill pool of
+    ``prefill_only`` chunked engines, decode pool of ordinary ones, same
+    cache kind on both sides so handoffs install bitwise) under a
+    :class:`PoolCapacityController` sizing the pools on TTFT-burn vs
+    TPOT-burn.  Fully traced for flow-chain continuity assertions."""
+    from apex_tpu.observability import FlightRecorder, Tracer
+    from apex_tpu.observability.slo import SLOMonitor, SLOTarget
+    from apex_tpu.resilience import PoolCapacityController
+    from apex_tpu.serving import (DegradationLadder, DisaggregatedFleet,
+                                  KvChannel, PagedInferenceEngine,
+                                  TickScheduler)
+    from apex_tpu.utils.profiling import ServingMetrics
+
+    model, params = _build_model(args)
+    kv_quant = None if args.kv_quant in (None, "none") else args.kv_quant
+
+    def engine(prefill_only, tracer=None):
+        slo = SLOMonitor(
+            [SLOTarget("ttft", args.ttft_slo_s, objective=0.9),
+             SLOTarget("token_latency", args.tpot_slo_s, objective=0.9)],
+            clock=clock)
+        return PagedInferenceEngine(
+            model, params, max_slots=args.max_slots,
+            block_size=args.block_size, chunked_prefill=True,
+            prefill_only=prefill_only, kv_quant=kv_quant,
+            scheduler=TickScheduler(token_budget=args.token_budget),
+            metrics=ServingMetrics(clock, slo=slo),
+            max_queue=args.max_queue, clock=clock, tracer=tracer)
+
+    tracers = {f"p{i}": Tracer(clock=clock, id_tag=f"p{i}")
+               for i in range(args.prefill_replicas)}
+    tracers.update({f"d{i}": Tracer(clock=clock, id_tag=f"d{i}")
+                    for i in range(args.decode_replicas)})
+    prefill = [engine(True, tracers[f"p{i}"])
+               for i in range(args.prefill_replicas)]
+    decode = [engine(False, tracers[f"d{i}"])
+              for i in range(args.decode_replicas)]
+    ladder = DegradationLadder(
+        thresholds=(args.burn_threshold / 7.2, args.burn_threshold / 2.4,
+                    args.burn_threshold),
+        step_down_s=args.ladder_step_down_s)
+    fleet = DisaggregatedFleet(
+        prefill, decode, clock=clock, channel=KvChannel(),
+        ladder=ladder, seed=args.seed,
+        recorder=FlightRecorder(clock=clock),
+        tracer=Tracer(clock=clock, id_tag="router"),
+        prefill_kw=dict(max_queue_depth=args.max_queue_depth,
+                        burn_threshold=args.burn_threshold,
+                        burn_window_s=args.burn_window_s,
+                        retry_budget=args.retry_budget),
+        decode_kw=dict(max_queue_depth=args.max_queue_depth,
+                       burn_threshold=args.burn_threshold,
+                       burn_window_s=args.burn_window_s,
+                       retry_budget=args.retry_budget))
+    def factory(pool):
+        # a shifted-in replica traces like the original ones, or the
+        # continuity gate would see its finishes vanish mid-chain
+        tag = f"{pool[0]}x{len(tracers)}"
+        tracers[tag] = Tracer(clock=clock, id_tag=tag)
+        return engine(pool == "prefill", tracers[tag])
+
+    controller = PoolCapacityController(
+        {"prefill": fleet.prefill, "decode": fleet.decode}, factory,
+        burn_high=args.burn_threshold, burn_low=1.0,
+        burn_window_s=args.burn_window_s,
+        confirm_ticks=3, cooldown_s=2.0, clock=clock)
+    fleet._tracers = tracers            # for the continuity collector
+    return fleet, controller
+
+
+def synthesize_disagg(args):
+    """(arrival, Request, tag) triples for the disagg scenarios.
+
+    ``disagg_diurnal``: the first half of the workload is
+    ``prefill_heavy`` (prompts ~4× the baseline, generations ~¼), the
+    second half ``decode_heavy`` (short prompts, full-length
+    generations) — the mid-day mix flip the pool controller reacts to.
+    ``disagg_longctx_fair``: ``--tenants`` round-robin tenants; tenant
+    0 submits near-context-limit prompts, the rest short interactive
+    ones."""
+    from apex_tpu.inference import Request
+
+    rng = np.random.RandomState(args.seed)
+    n = args.requests
+    work, t = [], 0.0
+    cap = args.max_seq - args.max_new - 1
+    for i in range(n):
+        t += float(rng.exponential(1.0 / args.rate))
+        if args.scenario == "disagg_diurnal":
+            heavy = i < n // 2
+            tag = "prefill_heavy" if heavy else "decode_heavy"
+            base = args.min_prompt * 4 if heavy else args.min_prompt
+            new = max(2, args.max_new // 4) if heavy else args.max_new
+            tail = min(int(rng.pareto(args.pareto_shape) * base) + base,
+                       args.max_seq - new - 1)
+        else:
+            tenant = i % args.tenants
+            tag = f"tenant{tenant}"
+            new = args.max_new
+            if tenant == 0:             # the long-context tenant
+                tail = cap - int(rng.randint(0, max(1, cap // 8)))
+                tail = min(tail, args.max_seq - new - 1)
+            else:
+                tail = min(int(rng.pareto(args.pareto_shape)
+                               * args.min_prompt) + args.min_prompt,
+                           args.max_seq - new - 1)
+        toks = list(rng.randint(1, args.vocab, tail).astype(int))
+        work.append((t, Request(i, toks, max_new_tokens=new, seed=i),
+                     tag))
+    return work
+
+
+def run_disagg_scenario(args) -> dict:
+    """Drive one disaggregated scenario on the virtual clock.  The
+    report carries the exactly-once ledger, per-phase (or per-tenant)
+    SLO attainment, the handoff ledger, the capacity audit, and a
+    ``gates`` dict the CI legs assert every value of."""
+    from apex_tpu.observability import FleetCollector
+    from apex_tpu.serving import RequestShed, VirtualClock
+
+    clock = VirtualClock()
+    fleet, controller = build_disagg_fleet(args, clock)
+    work = synthesize_disagg(args)
+    tags = {req.request_id: tag for _, req, tag in work}
+    mid_t = work[len(work) // 2][0]
+    crng = np.random.RandomState(args.seed + 1)
+    pending = [(t, i, req, int(args.client_retries))
+               for i, (t, req, _) in enumerate(work)]
+    seq = len(pending)
+    submit_t: dict = {}
+    finish_t: dict = {}
+    submitted: set = set()
+    shed_client: dict = {}
+    ticks = seen = 0
+    shift_requested = False
+    while True:
+        now = clock()
+        if args.scenario == "disagg_diurnal" and not shift_requested \
+                and now >= mid_t:
+            # the mid-day flip: decode-heavy afternoon needs the chip
+            # more than the now-quiet prefill pool does
+            controller.request_shift("to_decode")
+            shift_requested = True
+        while pending and pending[0][0] <= now:
+            _, _, req, retries = pending.pop(0)
+            try:
+                fleet.submit(req)
+                submitted.add(req.request_id)
+                submit_t.setdefault(req.request_id, now)
+                shed_client.pop(req.request_id, None)
+            except RequestShed as e:
+                if retries > 0:
+                    back = e.retry_after_s * (1.0 + 0.5 * crng.rand())
+                    bisect.insort(pending,
+                                  (now + back, seq, req, retries - 1))
+                    seq += 1
+                else:
+                    shed_client[req.request_id] = e.reason.value
+        busy = fleet.step()
+        controller.tick()
+        clock.advance(args.tick_s)
+        ticks += 1
+        done = fleet.completed
+        while seen < len(done):
+            finish_t[done[seen].request_id] = clock()
+            seen += 1
+        if not pending and not busy and fleet.pending == 0 \
+                and not controller.shifting:
+            break
+        if ticks >= args.max_ticks:
+            break
+    responses = {r.request_id: r for r in fleet.completed}
+    lost = sorted(submitted - set(responses))
+    per_phase: dict = {}
+    for rid, rep in responses.items():
+        if rep.finish_reason not in ("eos", "length") \
+                or rid not in finish_t or rid not in submit_t:
+            continue
+        per_phase.setdefault(tags[rid], []).append(
+            finish_t[rid] - submit_t[rid])
+    attainment = {
+        tag: sum(1 for v in xs if v <= args.e2e_slo_s) / len(xs)
+        for tag, xs in sorted(per_phase.items())}
+    fc = FleetCollector()
+    fc.add_replica("router", tracer=fleet.prefill.tracer)
+    for name, tr in fleet._tracers.items():
+        fc.add_replica(name, tracer=tr)
+    cont = fc.continuity()
+    audit = controller.audit()
+    gates = {
+        "exactly_once": not lost and fleet.duplicate_responses == 0
+        and fleet.pending == 0,
+        "slo_attainment": bool(attainment)
+        and all(a >= 0.9 for a in attainment.values()),
+        "capacity_audit_clean": audit == [],
+        "no_broken_chains": not cont["broken"],
+    }
+    return {
+        "scenario": args.scenario,
+        "requests": args.requests,
+        "submitted": len(submitted),
+        "responses": len(responses),
+        "lost": lost,
+        "duplicated": fleet.duplicate_responses,
+        "shed_client": len(shed_client),
+        "outcomes": _outcome_counts(responses, len(shed_client)),
+        "fleet_pending": fleet.pending,
+        "ticks": ticks,
+        "virtual_s": clock(),
+        "tokens": sum(len(r.tokens) for r in responses.values()),
+        "slo_attainment": attainment,
+        "handoffs": fleet.handoffs,
+        "fallbacks": fleet.fallbacks,
+        "handoff_bytes": fleet.channel.handoff_bytes,
+        "pool_split": controller.split,
+        "pool_shifts": controller.stats["shifts"],
+        "capacity_audit": audit,
+        "trace_continuity": {
+            "chains": len(cont["chains"]),
+            "complete": len(cont["complete"]),
+            "broken": cont["broken"],
+            "orphans": cont["orphans"],
+        },
+        "gates": gates,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--requests", type=int, default=32)
@@ -511,6 +757,16 @@ def main(argv=None) -> int:
     ap.add_argument("--burst-gap-s", type=float, default=0.5)
     ap.add_argument("--period-s", type=float, default=4.0,
                     help="diurnal modulation period (virtual seconds)")
+    # disaggregated scenarios
+    ap.add_argument("--prefill-replicas", type=int, default=2)
+    ap.add_argument("--decode-replicas", type=int, default=2)
+    ap.add_argument("--kv-quant", choices=("none", "int8"),
+                    default="none",
+                    help="decode+prefill pool KV cache storage")
+    ap.add_argument("--tpot-slo-s", type=float, default=0.5)
+    ap.add_argument("--tenants", type=int, default=3,
+                    help="round-robin tenants for disagg_longctx_fair "
+                    "(tenant 0 is the long-context one)")
     # workload shape
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--min-prompt", type=int, default=8)
@@ -540,6 +796,28 @@ def main(argv=None) -> int:
             print(json.dumps(report, indent=2))
         else:
             day_in_life.print_report(report)
+        return 0 if all(report["gates"].values()) else 1
+
+    if args.scenario in DISAGG_SCENARIOS:
+        report = run_disagg_scenario(args)
+        if args.json:
+            print(json.dumps(report, indent=2))
+        else:
+            print(f"scenario {report['scenario']}: "
+                  f"{report['responses']}/{report['submitted']} answered "
+                  f"(lost {len(report['lost'])}, "
+                  f"dup {report['duplicated']}) in {report['ticks']} "
+                  f"ticks / {report['virtual_s']:.2f}s virtual")
+            print(f"  outcomes {report['outcomes']}")
+            print(f"  handoffs {report['handoffs']}  "
+                  f"fallbacks {report['fallbacks']}  "
+                  f"bytes {report['handoff_bytes']}")
+            print(f"  pool split {report['pool_split']}  "
+                  f"shifts {report['pool_shifts']}  "
+                  f"audit {report['capacity_audit']}")
+            for tag, a in report["slo_attainment"].items():
+                print(f"  slo[{tag}] {a:.0%}")
+            print(f"  gates {report['gates']}")
         return 0 if all(report["gates"].values()) else 1
 
     if args.scenario is not None:
