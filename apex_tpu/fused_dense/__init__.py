@@ -2,9 +2,18 @@
 ``apex/fused_dense/fused_dense.py`` (+ ``csrc/fused_dense_cuda.cu``).
 
 Apex uses cuBLASLt epilogues (bias, gelu, dgelu+bgrad) to fuse the Linear(+
-GELU +Linear) chain.  XLA performs the same epilogue fusion on TPU (bias add
-and GELU fuse into the MXU matmul's output), so these are functional modules
-whose whole value is matching the apex module/`_function` surface.
+GELU +Linear) chain.  XLA performs the *epilogue* half of that fusion on TPU
+(bias add and GELU fuse into the MXU matmul's output — pinned by
+``tests/test_on_chip.py::TestXlaFusionClaim``), so by default these are
+functional modules whose value is matching the apex module/`_function`
+surface.  What XLA does NOT fuse is the GEMM→GEMM hop: the ``(tokens,
+intermediate)`` activation still round-trips through HBM between the two
+matmuls, twice per direction counting the backward.  ``fused_ffn=True``
+closes that gap by routing the GELU pair onto the Pallas fused-FFN kernel
+(:mod:`apex_tpu.ops.fused_ffn` — one pass, f32 accumulation, the
+pre-activation as the only saved residual), the same kernel the model
+configs enable via their ``fused_ffn`` knob; off-TPU it falls back to a
+bitwise-identical unfused reference, so the flag is safe to leave on.
 """
 
 from __future__ import annotations
@@ -28,9 +37,16 @@ def fused_dense_function(x, weight, bias=None):
     return y
 
 
-def fused_dense_gelu_dense_function(x, weight1, bias1, weight2, bias2):
-    """Linear→GELU→Linear in one fusion region (apex
-    ``fused_dense_gelu_dense_function``)."""
+def fused_dense_gelu_dense_function(x, weight1, bias1, weight2, bias2,
+                                    fused_ffn=False):
+    """Linear→GELU→Linear (apex ``fused_dense_gelu_dense_function``).
+
+    ``fused_ffn=True`` runs the pair as ONE Pallas kernel
+    (:func:`apex_tpu.ops.fused_ffn.fused_ffn` — the implementation the
+    model FFNs share); default keeps the XLA epilogue-fusion chain."""
+    if fused_ffn:
+        from apex_tpu.ops.fused_ffn import fused_ffn as _fused_ffn
+        return _fused_ffn(x, weight1, bias1, weight2, bias2)
     h = jax.nn.gelu(x @ weight1.T + bias1, approximate=True)
     return h @ weight2.T + bias2
 
@@ -71,7 +87,7 @@ class FusedDenseGeluDense(_DenseBase):
     """apex ``FusedDenseGeluDense(in, intermediate, out)``."""
 
     def __init__(self, in_features, intermediate_features, out_features,
-                 bias=True, param_dtype=jnp.float32):
+                 bias=True, param_dtype=jnp.float32, fused_ffn=False):
         if not bias:
             raise ValueError(
                 "FusedDenseGeluDense module without bias is currently not "
@@ -80,6 +96,7 @@ class FusedDenseGeluDense(_DenseBase):
         self.intermediate_features = int(intermediate_features)
         self.out_features = int(out_features)
         self.param_dtype = param_dtype
+        self.fused_ffn = bool(fused_ffn)
 
     def init_params(self, key):
         k1, k2 = jax.random.split(key)
@@ -92,6 +109,6 @@ class FusedDenseGeluDense(_DenseBase):
     def __call__(self, params, x):
         return fused_dense_gelu_dense_function(
             x, params["weight1"], params["bias1"], params["weight2"],
-            params["bias2"])
+            params["bias2"], fused_ffn=self.fused_ffn)
 
     apply = __call__

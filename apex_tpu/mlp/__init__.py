@@ -9,7 +9,10 @@ fuse into their epilogues — so the module is a plain functional chain; the
 The claim is pinned by the on-chip lane
 (``tests/test_on_chip.py::TestXlaFusionClaim``): the compiled ENTRY
 computation contains only fusions/GEMMs/plumbing — a standalone
-elementwise kernel (un-fused epilogue) fails the test.
+elementwise kernel (un-fused epilogue) fails the test.  That covers the
+epilogues only; the GEMM→GEMM activation still crosses HBM, and
+``fused_ffn=True`` routes the 2-layer GELU shape onto the Pallas
+fused-FFN kernel (:mod:`apex_tpu.ops.fused_ffn`) that keeps it in VMEM.
 """
 
 from __future__ import annotations
@@ -34,10 +37,25 @@ def _activate(h, activation):
     raise ValueError(f"unsupported activation {activation!r}")
 
 
-def mlp_forward(params, x, activation="relu"):
+def mlp_forward(params, x, activation="relu", fused_ffn=False):
     """Chained ``x @ W.T + b`` with activation between layers (last layer
-    linear) — apex ``mlp_function`` semantics, weights stored (out, in)."""
+    linear) — apex ``mlp_function`` semantics, weights stored (out, in).
+
+    ``fused_ffn=True`` routes the canonical 2-layer GELU shape onto the
+    Pallas fused-FFN kernel (:mod:`apex_tpu.ops.fused_ffn`) — the same
+    implementation the model FFNs use; other shapes raise so a silently
+    unfused path cannot masquerade as the kernel."""
     n = len(params["weights"])
+    if fused_ffn:
+        if n != 2 or activation != "gelu" \
+                or params.get("biases") is None:
+            raise ValueError(
+                "fused_ffn covers the 2-layer biased GELU MLP "
+                f"(got {n} layers, activation={activation!r}, "
+                f"biases={'yes' if params.get('biases') else 'no'})")
+        from apex_tpu.ops.fused_ffn import fused_ffn as _fused_ffn
+        return _fused_ffn(x, params["weights"][0], params["biases"][0],
+                          params["weights"][1], params["biases"][1])
     h = x
     for i, w in enumerate(params["weights"]):
         h = h @ w.T
@@ -56,7 +74,8 @@ class MLP:
     """
 
     def __init__(self, mlp_sizes: Sequence[int], bias=True, relu=True,
-                 activation=None, param_dtype=jnp.float32):
+                 activation=None, param_dtype=jnp.float32,
+                 fused_ffn=False):
         if len(mlp_sizes) < 2:
             raise ValueError("MLP needs at least an input and output size")
         self.mlp_sizes = tuple(int(s) for s in mlp_sizes)
@@ -65,6 +84,7 @@ class MLP:
             activation = "relu" if relu else "none"
         self.activation = activation
         self.param_dtype = param_dtype
+        self.fused_ffn = bool(fused_ffn)
 
     def init_params(self, key):
         weights, biases = [], []
@@ -88,6 +108,7 @@ class MLP:
         return params
 
     def __call__(self, params, x):
-        return mlp_forward(params, x, self.activation)
+        return mlp_forward(params, x, self.activation,
+                           fused_ffn=self.fused_ffn)
 
     apply = __call__

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from apex_tpu.models.gpt import _remat_policy
 from apex_tpu.normalization import MixedFusedLayerNorm
 from apex_tpu.ops.flash_attention import flash_attention
+from apex_tpu.ops.fused_ffn import fused_ffn_tp
 from apex_tpu.transformer import tensor_parallel as tp
 
 _f32 = jnp.float32
@@ -45,6 +46,7 @@ class BertConfig:
     axis_name: Optional[str] = None
     sequence_parallel: bool = False
     overlap_chunks: int = 0                    # >0: ppermute-ring TP GEMMs
+    fused_ffn: bool = False                    # Pallas fused bias-GELU FFN
     remat: bool = False
     remat_policy: str = "full"                 # "full" | "dots" (selective)
     dtype: jnp.dtype = jnp.float32
@@ -164,12 +166,23 @@ class BertLayer:
         return p
 
     def __call__(self, params, x, seqlens=None):
+        cfg = self.cfg
         h = self.attention(params["attention"], x, seqlens)
         x = self.attention_layernorm(
             self._sp_ln_params(params, "attention_layernorm"), x + h)
-        h, _ = self.fc1(params["fc1"], x)
-        h = jax.nn.gelu(h, approximate=True)
-        h, _ = self.fc2(params["fc2"], h)
+        if cfg.fused_ffn:
+            # Pallas fused GEMM+bias+GELU+GEMM with the same TP/SP edge
+            # collectives the unfused fc1/fc2 pair uses
+            h = fused_ffn_tp(
+                x, params["fc1"]["weight"], params["fc1"]["bias"],
+                params["fc2"]["weight"], params["fc2"]["bias"],
+                tensor_parallel_size=cfg.tensor_parallel_size,
+                axis_name=cfg.axis_name,
+                sequence_parallel=cfg.sequence_parallel, seq_dim=1)
+        else:
+            h, _ = self.fc1(params["fc1"], x)
+            h = jax.nn.gelu(h, approximate=True)
+            h, _ = self.fc2(params["fc2"], h)
         return self.output_layernorm(
             self._sp_ln_params(params, "output_layernorm"), x + h)
 

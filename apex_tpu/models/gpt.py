@@ -31,6 +31,7 @@ from apex_tpu.ops.flash_attention import (flash_attention,
                                           flash_attention_decode_paged,
                                           flash_attention_decode_paged_quant,
                                           quantize_kv_blocks)
+from apex_tpu.ops.fused_ffn import fused_ffn_tp
 from apex_tpu.ops.rope import (fused_apply_rotary_pos_emb_at_positions,
                                fused_apply_rotary_pos_emb_cached, rope_freqs)
 from apex_tpu.transformer import tensor_parallel as tp
@@ -79,6 +80,7 @@ class GPTConfig:
     expert_parallel_size: int = 1
     attention_dropout: float = 0.0             # fused flash-kernel dropout
     fused_lm_head: bool = True                 # logit-free blockwise CE
+    fused_ffn: bool = False                    # Pallas fused bias-GELU FFN
     remat: bool = False                        # jax.checkpoint each layer
     remat_policy: str = "full"                 # "full" | "dots" (selective)
     dtype: jnp.dtype = jnp.float32             # activation/compute dtype
@@ -144,6 +146,11 @@ class GPTConfig:
                 "sequence_parallel does not compose with MoE FFNs: the "
                 "router's TP-internal psum assumes every tensor rank sees "
                 "the same (replicated) tokens, but SP shards them")
+        if self.fused_ffn and self.n_experts > 0:
+            raise ValueError(
+                "fused_ffn fuses the dense ParallelMLP pair; with "
+                "n_experts > 0 every FFN slot is a MoEFFN and the knob "
+                "would be silently dead — enable one or the other")
 
     @property
     def head_dim(self):
@@ -488,6 +495,17 @@ class ParallelMLP:
                 "fc2": self.fc2.init_params(k2)}
 
     def __call__(self, params, x):
+        cfg = self.cfg
+        if cfg.fused_ffn:
+            # one Pallas op for GEMM+bias+GELU+GEMM, wrapped in the same
+            # TP/SP edge collectives the unfused pair uses (bias2 after
+            # the reduce) — bitwise vs unfused off-TPU at overlap 0
+            return fused_ffn_tp(
+                x, params["fc1"]["weight"], params["fc1"]["bias"],
+                params["fc2"]["weight"], params["fc2"]["bias"],
+                tensor_parallel_size=cfg.tensor_parallel_size,
+                axis_name=cfg.axis_name,
+                sequence_parallel=cfg.sequence_parallel, seq_dim=1)
         h, _ = self.fc1(params["fc1"], x)
         h = jax.nn.gelu(h, approximate=True)
         y, _ = self.fc2(params["fc2"], h)

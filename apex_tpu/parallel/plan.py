@@ -75,6 +75,7 @@ class ParallelPlan:
     pp: int = 1
     sequence_parallel: bool = False
     overlap_chunks: int = 0
+    fused_ffn: bool = False
     n_virtual: int = 1
     n_microbatches: int = 1
     remat: bool = False
@@ -232,6 +233,7 @@ class ParallelPlan:
                 "axis_name": self.axis_name,
                 "sequence_parallel": self.sequence_parallel,
                 "overlap_chunks": self.overlap_chunks,
+                "fused_ffn": self.fused_ffn,
                 "remat": self.remat,
                 "remat_policy": self.remat_policy}
 
@@ -256,8 +258,10 @@ class ParallelPlan:
              "remat_policy": str(self.remat_policy),
              "allreduce_dtype": self.allreduce_dtype,
              "zero_shard": int(self.zero_shard)}
-        # cross-pod fields only when set, so single-pod plan documents
-        # stay byte-identical to pre-MPMD writers
+        # opt-in fields only when set, so default plan documents stay
+        # byte-identical to earlier writers
+        if self.fused_ffn:
+            d["fused_ffn"] = True
         if self.n_pods != 1:
             d["n_pods"] = int(self.n_pods)
         if self.stage_plans is not None:
@@ -279,6 +283,7 @@ class ParallelPlan:
               "pp": int(d.get("pp", 1)),
               "sequence_parallel": bool(d.get("sequence_parallel", False)),
               "overlap_chunks": int(d.get("overlap_chunks", 0)),
+              "fused_ffn": bool(d.get("fused_ffn", False)),
               "n_virtual": int(d.get("n_virtual", 1)),
               "n_microbatches": int(d.get("n_microbatches", 1)),
               "remat": bool(d.get("remat", False)),
@@ -298,6 +303,8 @@ class ParallelPlan:
                 f"zero={self.zero_shard}"]
         if self.overlap_chunks:
             bits.append(f"overlap={self.overlap_chunks}")
+        if self.fused_ffn:
+            bits.append("ffn=fused")
         if self.pp > 1 or self.n_microbatches > 1:
             bits.append(f"mb={self.n_microbatches}")
         if self.n_virtual > 1:
@@ -317,7 +324,7 @@ class ParallelPlan:
 # -- config back-compat bridge ------------------------------------------------
 
 _CONFIG_KNOBS = ("tensor_parallel_size", "sequence_parallel",
-                 "overlap_chunks", "remat", "remat_policy")
+                 "overlap_chunks", "fused_ffn", "remat", "remat_policy")
 
 
 def apply_plan_to_config(cfg) -> None:
@@ -340,6 +347,7 @@ def apply_plan_to_config(cfg) -> None:
     values = {"tensor_parallel_size": plan.tp,
               "sequence_parallel": plan.sequence_parallel,
               "overlap_chunks": plan.overlap_chunks,
+              "fused_ffn": plan.fused_ffn,
               "remat": plan.remat,
               "remat_policy": plan.remat_policy}
     for field in _CONFIG_KNOBS:

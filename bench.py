@@ -518,17 +518,20 @@ def bench_gpt_decode():
 # ---------------------------------------------------------------------------
 
 def bench_bert_breakdown():
-    """Per-component times at the BERT-large shapes (batch 32 x seq 512
-    equivalents), each isolated and repeated inside ONE jitted scan so
-    the ~5-8 ms per-dispatch tunnel cost cannot dominate a small op.
-    Sum of components ~= the un-rematted step; this names where the
+    """Per-component times at the HEADLINE step's shapes — batch 16 x
+    seq 512, x2 accumulation microbatches per step (the optimizer runs
+    once per step, after accumulation, so it is NOT doubled) — each
+    isolated and repeated inside ONE jitted scan so the ~5-8 ms
+    per-dispatch tunnel cost cannot dominate a small op.  Sum of
+    components ~= the un-rematted headline step; this names where the
     step's time goes (bench extra ``breakdown``)."""
     from apex_tpu.normalization import MixedFusedLayerNorm
     from apex_tpu.ops.flash_attention import flash_attention
     from apex_tpu.ops.lm_head import fused_linear_cross_entropy
     from apex_tpu.optimizers import FusedLAMB
 
-    b, s, h, nh, L, V = 32, 512, 1024, 16, 24, 30528
+    b, s, h, nh, L, V = 16, 512, 1024, 16, 24, 30528
+    accum = 2                     # headline: batch 16 x 2 accum
     hd = h // nh
     f = 4 * h
     rng = np.random.RandomState(0)
@@ -548,7 +551,7 @@ def bench_bert_breakdown():
     q = jnp.asarray(rng.randn(b, nh, s, hd), bf)
     k = jnp.asarray(rng.randn(b, nh, s, hd), bf)
     v = jnp.asarray(rng.randn(b, nh, s, hd), bf)
-    out["attention"] = L * t_chain(
+    out["attention"] = accum * L * t_chain(
         lambda q, k, v: flash_attention(q, k, v, causal=False), q, k, v)
     del q, k, v
     jax.clear_caches()
@@ -556,14 +559,14 @@ def bench_bert_breakdown():
     x = jnp.asarray(rng.randn(b * s, h), bf)
     wqkv = jnp.asarray(rng.randn(h, 3 * h) * 0.02, bf)
     wproj = jnp.asarray(rng.randn(h, h) * 0.02, bf)
-    out["qkv_proj_gemms"] = L * t_chain(
+    out["qkv_proj_gemms"] = accum * L * t_chain(
         lambda x, a, c: ((x @ a)[:, :h] @ c), x, wqkv, wproj)
     del wqkv, wproj
     jax.clear_caches()
 
     w1 = jnp.asarray(rng.randn(h, f) * 0.02, bf)
     w2 = jnp.asarray(rng.randn(f, h) * 0.02, bf)
-    out["ffn"] = L * t_chain(
+    out["ffn"] = accum * L * t_chain(
         lambda x, w1, w2: jax.nn.gelu(x @ w1, approximate=True) @ w2,
         x, w1, w2, reps=8)
     del w1, w2
@@ -572,7 +575,7 @@ def bench_bert_breakdown():
     ln = MixedFusedLayerNorm(h)
     lp = ln.init_params()
     xf = jnp.asarray(rng.randn(b, s, h), bf)
-    out["layernorm"] = 2 * L * t_chain(
+    out["layernorm"] = accum * 2 * L * t_chain(
         lambda x, p: ln(p, x), xf, lp, reps=48)
     del xf, lp
     jax.clear_caches()
@@ -581,8 +584,8 @@ def bench_bert_breakdown():
     tgt = jnp.asarray(rng.randint(0, V, (b * s,)))
     g = jax.jit(jax.grad(lambda hd_, w: jnp.mean(
         fused_linear_cross_entropy(hd_, w, tgt)), argnums=(0, 1)))
-    out["lm_head_ce"] = _time_steps(g, (x, emb), warmup=1, iters=4,
-                                    rounds=3)
+    out["lm_head_ce"] = accum * _time_steps(g, (x, emb), warmup=1,
+                                            iters=4, rounds=3)
     del x, emb, tgt, g
     jax.clear_caches()
 
@@ -624,8 +627,9 @@ def bench_bert_breakdown():
         **{k: round(v, 5) for k, v in out.items()},
         "sum_s": round(total, 5),
         "top_consumer": max(out, key=out.get),
-        "note": "isolated fwd+bwd per component x layer count at batch "
-                "32 shapes; headline step runs batch 16 x 2 accum",
+        "note": "isolated fwd+bwd per component x layer count x 2 "
+                "accum microbatches at the headline batch-16 shapes; "
+                "optimizer once per step (after accumulation)",
     }
 
 
@@ -1971,6 +1975,84 @@ def bench_mpmd():
     }
 
 
+def bench_fused_ffn():
+    """Fused-FFN leg (ISSUE 17): the Pallas fused bias-GELU FFN pair vs
+    the unfused XLA chain, fwd+bwd at the BERT-large headline FFN shape
+    (16x512 tokens, 1024 -> 4096 -> 1024, bf16).
+
+    On TPU the fused arm runs the kernel and the speedup prices the
+    HBM round-trip of the ``(tokens, ffn_hidden)`` activation the
+    unfused chain pays between its two GEMMs.  Off-TPU the fused arm
+    dispatches to the bitwise unfused reference, so the speedup
+    honestly reads ~1.0 — the recorded ``path`` says which arm ran;
+    tiling sweeps live in ``tools/sweep_ffn.py``."""
+    from apex_tpu.ops.fused_ffn import fused_ffn, fused_ffn_reference
+    from apex_tpu.utils import use_pallas
+
+    m, h, f = 16 * 512, 1024, 4096
+    rng = np.random.RandomState(0)
+    bf = jnp.bfloat16
+    x = jnp.asarray(rng.randn(m, h), bf)
+    w1 = jnp.asarray(rng.randn(f, h) * 0.02, bf)
+    b1 = jnp.asarray(rng.randn(f) * 0.02, bf)
+    w2 = jnp.asarray(rng.randn(h, f) * 0.02, bf)
+    b2 = jnp.asarray(rng.randn(h) * 0.02, bf)
+    args = (x, w1, b1, w2, b2)
+
+    def grad_of(ffn):
+        def loss(*a):
+            return jnp.sum(ffn(*a).astype(jnp.float32))
+        return jax.jit(jax.grad(loss, argnums=(0, 1, 2, 3, 4)))
+
+    t_unfused = _time_steps(grad_of(fused_ffn_reference), args,
+                            warmup=2, iters=8, rounds=3)
+    jax.clear_caches()
+    t_fused = _time_steps(grad_of(fused_ffn), args,
+                          warmup=2, iters=8, rounds=3)
+    jax.clear_caches()
+    return {"tokens": m, "hidden": h, "ffn_hidden": f,
+            "dtype": "bfloat16",
+            "path": "pallas" if use_pallas() else "reference",
+            "unfused_s": round(t_unfused, 6),
+            "fused_s": round(t_fused, 6),
+            "speedup": round(t_unfused / t_fused, 4)}
+
+
+def bench_mfu_multichip():
+    """Multi-chip MFU leg (ISSUE 17): per-chip achieved FLOPs and MFU
+    for dp x tp train steps with the fused-FFN knob on, plus the
+    autotune planner's predicted-vs-measured gap at those plans.
+
+    Runs ``tools/mfu_multichip.py`` over an 8-device host mesh in a
+    subprocess pinned to the host platform (this process owns the TPU;
+    the tool owns its mesh — the ``bench_autotune`` idiom).  The MFU
+    denominator is the same calibrated matmul roofline the planner
+    ranks with, so the fraction is honest on CPU hosts too."""
+    import subprocess
+    import sys
+    import tempfile
+
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools", "mfu_multichip.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    t0 = time.perf_counter()
+    with tempfile.TemporaryDirectory() as tmp:
+        out_path = os.path.join(tmp, "mfu_multichip.json")
+        out = subprocess.run(
+            [sys.executable, script, "--devices", "8", "--out", out_path,
+             "--quiet"],
+            capture_output=True, text=True, env=env, timeout=1200)
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"mfu_multichip failed (exit {out.returncode}): "
+                f"{out.stderr[-1500:]}")
+        with open(out_path) as f:
+            report = json.load(f)
+    report["total_wall_s"] = round(time.perf_counter() - t0, 3)
+    return report
+
+
 def _extra_legs():
     """Leg name (as it appears under the result's ``extra``) -> bench
     function, for ``--legs`` subset runs."""
@@ -1995,6 +2077,8 @@ def _extra_legs():
         "lint": bench_lint,
         "autotune": bench_autotune,
         "mpmd": bench_mpmd,
+        "fused_ffn": bench_fused_ffn,
+        "mfu_multichip": bench_mfu_multichip,
     }
 
 
@@ -2089,6 +2173,8 @@ def main(argv=None):
     lint_gate = _retry(bench_lint)
     autotune_leg = _retry(bench_autotune)
     mpmd = _retry(bench_mpmd)
+    fused_ffn_leg = _retry(bench_fused_ffn)
+    mfu_multichip = _retry(bench_mfu_multichip)
     rounded = lambda d: (None if d is None else
                          {k: (round(v, 6) if isinstance(v, float) else v)
                           for k, v in d.items()})
@@ -2124,6 +2210,8 @@ def main(argv=None):
             "lint": lint_gate,
             "autotune": autotune_leg,
             "mpmd": mpmd,
+            "fused_ffn": fused_ffn_leg,
+            "mfu_multichip": mfu_multichip,
         },
     }
     result["metrics_stream"] = stream_path

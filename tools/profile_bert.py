@@ -4,7 +4,10 @@ breakdown (VERDICT r4 items 1+2).
 
 Runs each candidate train-step config with the bench.py hard-sync
 protocol and prints tokens/s; then times isolated sub-components at the
-BERT-large shapes so BENCH_r05 can ship a `breakdown` dict.
+headline step's shapes (batch 16 x seq 512, x2 accumulation
+microbatches; the optimizer runs once per step) so the bench can ship a
+`breakdown` dict whose component seconds sum comparably to the headline
+step.
 
 Usage:
     python tools/profile_bert.py sweep      # remat/batch sweep
@@ -125,7 +128,8 @@ def breakdown():
     from apex_tpu.ops.lm_head import fused_linear_cross_entropy
     from apex_tpu.optimizers import FusedLAMB
 
-    b, s, h, nh, L, V = 32, 512, 1024, 16, 24, 30528
+    b, s, h, nh, L, V = 16, 512, 1024, 16, 24, 30528
+    accum = 2                     # headline: batch 16 x 2 accum
     hd = h // nh
     f = 4 * h
     rng = np.random.RandomState(0)
@@ -160,7 +164,7 @@ def breakdown():
     q = jnp.asarray(rng.randn(b, nh, s, hd), bf)
     k = jnp.asarray(rng.randn(b, nh, s, hd), bf)
     v = jnp.asarray(rng.randn(b, nh, s, hd), bf)
-    done("attention", L * t_chain(
+    done("attention", accum * L * t_chain(
         lambda q, k, v: flash_attention(q, k, v, causal=False), q, k, v))
     del q, k, v
 
@@ -168,7 +172,7 @@ def breakdown():
     x = jnp.asarray(rng.randn(b * s, h), bf)
     wqkv = jnp.asarray(rng.randn(h, 3 * h) * 0.02, bf)
     wproj = jnp.asarray(rng.randn(h, h) * 0.02, bf)
-    done("qkv_proj_gemms", L * t_chain(
+    done("qkv_proj_gemms", accum * L * t_chain(
         lambda x, a, c: ((x @ a)[:, :h] @ c), x, wqkv, wproj))
     del wqkv, wproj
 
@@ -176,7 +180,7 @@ def breakdown():
     # (b*s, 4h) gelu inputs per rep, ~300 MB each)
     w1 = jnp.asarray(rng.randn(h, f) * 0.02, bf)
     w2 = jnp.asarray(rng.randn(f, h) * 0.02, bf)
-    done("ffn", L * t_chain(
+    done("ffn", accum * L * t_chain(
         lambda x, w1, w2: jax.nn.gelu(x @ w1, approximate=True) @ w2,
         x, w1, w2, reps=8))
     del w1, w2
@@ -185,7 +189,7 @@ def breakdown():
     ln = MixedFusedLayerNorm(h)
     lp = ln.init_params()
     xf = jnp.asarray(rng.randn(b, s, h), bf)
-    done("layernorm", 2 * L * t_chain(
+    done("layernorm", accum * 2 * L * t_chain(
         lambda x, p: ln(p, x), xf, lp, reps=48))
     del xf, lp
 
@@ -193,7 +197,7 @@ def breakdown():
     # dispatch ~50 ms, overhead negligible — no chaining needed)
     emb = jnp.asarray(rng.randn(V, h) * 0.02, bf)
     tgt = jnp.asarray(rng.randint(0, V, (b * s,)))
-    done("lm_head_ce", t_grad(
+    done("lm_head_ce", accum * t_grad(
         lambda hd_, w: fused_linear_cross_entropy(hd_, w, tgt),
         x, emb, iters=4))
     del x, emb, tgt
@@ -229,7 +233,8 @@ def breakdown():
     done("optimizer_lamb", _time(run, (grads,), iters=4) / reps)
 
     total = sum(out.values())
-    print("component breakdown (fwd+bwd isolated, x layer count):")
+    print("component breakdown (fwd+bwd isolated, x layer count x 2 "
+          "accum; optimizer once per step):")
     for k_, v_ in sorted(out.items(), key=lambda kv: -kv[1]):
         print(f"  {k_:>16}: {v_ * 1e3:7.1f} ms  ({v_ / total:5.1%})")
     print(f"  {'sum':>16}: {total * 1e3:7.1f} ms")
