@@ -152,7 +152,21 @@ class InferenceEngine:
                     "(GPTConfig(plan=plan))")
         self.plan = plan
         self.model = model
+        if getattr(cfg, "weight_quant", None) == "int8":
+            # quantize ONCE at init (never per step): every jitted
+            # program below closes over the int8 tree, and the layer /
+            # head dispatch keys on the weight_scale leaves.  Works
+            # per-TP-shard unchanged — per-output-channel scales
+            # commute with the row slices and only tighten on the
+            # column slices
+            from apex_tpu.models.gpt import quantize_decode_params
+            params = quantize_decode_params(params)
         self.params = params
+        # weight HBM per replica (the bench/CI legs' bytes accounting);
+        # .nbytes on a jax array is metadata — no host transfer
+        self.weight_bytes = int(sum(
+            getattr(l, "nbytes", 0)
+            for l in jax.tree_util.tree_leaves(params)))
         self.clock = clock
         # `registry` merges this engine's serving series into a shared
         # apex_tpu.observability.MetricsRegistry (one Prometheus/JSONL

@@ -81,6 +81,7 @@ class GPTConfig:
     attention_dropout: float = 0.0             # fused flash-kernel dropout
     fused_lm_head: bool = True                 # logit-free blockwise CE
     fused_ffn: bool = False                    # Pallas fused bias-GELU FFN
+    weight_quant: Optional[str] = None         # "int8": decode-path weights
     remat: bool = False                        # jax.checkpoint each layer
     remat_policy: str = "full"                 # "full" | "dots" (selective)
     dtype: jnp.dtype = jnp.float32             # activation/compute dtype
@@ -151,6 +152,22 @@ class GPTConfig:
                 "fused_ffn fuses the dense ParallelMLP pair; with "
                 "n_experts > 0 every FFN slot is a MoEFFN and the knob "
                 "would be silently dead — enable one or the other")
+        if self.weight_quant not in (None, "int8"):
+            raise ValueError(
+                f"weight_quant must be None or 'int8', got "
+                f"{self.weight_quant!r}")
+        if self.weight_quant is not None and self.n_experts > 0:
+            raise ValueError(
+                "weight_quant covers the dense qkv/proj/fc1/fc2/lm-head "
+                "GEMMs; MoE expert stacks (n_experts > 0) keep their own "
+                "3D weight layout that quantize_decode_params does not "
+                "produce — disable one or the other")
+        if self.weight_quant is not None and self.fused_ffn:
+            raise ValueError(
+                "weight_quant routes the FFN through the int8 "
+                "dequant-GEMMs, which fused_ffn would bypass (the fused "
+                "kernel consumes raw f32/bf16 fc1/fc2 leaves) — enable "
+                "one or the other")
 
     @property
     def head_dim(self):
@@ -791,12 +808,23 @@ class GPTModel:
                 p, self.cfg.axis_name)
         return p
 
+    def _head_logits(self, params, x, eq):
+        """Tied-embedding head GEMM in f32.  A quantized tree (the
+        ``weight_quant="int8"`` leaves from
+        :func:`quantize_decode_params`) routes through the fused
+        dequant-GEMM; otherwise the original einsum runs unchanged, so
+        the knob-off path stays bitwise."""
+        emb = params["embedding"]
+        if "weight_scale" in emb:
+            from apex_tpu.ops.quant_gemm import quant_gemm
+            return quant_gemm(x.astype(_f32), emb["weight"],
+                              emb["weight_scale"])
+        return jnp.einsum(eq, x.astype(_f32), emb["weight"].astype(_f32))
+
     def logits(self, params, x):
         """Tied LM head: vocab-parallel logits ``(b, s, vocab/t)``."""
         x = self.final_layernorm(self._final_ln_params(params), x)
-        w = params["embedding"]["weight"]
-        return jnp.einsum("bsh,vh->bsv", x.astype(_f32),
-                          w.astype(_f32))
+        return self._head_logits(params, x, "bsh,vh->bsv")
 
     def head_loss(self, params, x, targets):
         """Per-token CE of the tied head on backbone output ``x``.
@@ -922,9 +950,7 @@ class GPTModel:
                                              params["layers"])):
             x, cache = layer.decode(lp, x, cache, li, positions)
         x = self.final_layernorm(params["final_layernorm"], x)
-        w = params["embedding"]["weight"]
-        logits = jnp.einsum("bh,vh->bv", x[:, 0].astype(_f32),
-                            w.astype(_f32))
+        logits = self._head_logits(params, x[:, 0], "bh,vh->bv")
         return logits, cache
 
     def decode_step_paged(self, params, tokens, pool, block_tables,
@@ -951,9 +977,7 @@ class GPTModel:
             x, pool = layer.decode_paged(lp, x, pool, li, block_tables,
                                          positions)
         x = self.final_layernorm(params["final_layernorm"], x)
-        w = params["embedding"]["weight"]
-        logits = jnp.einsum("bh,vh->bv", x[:, 0].astype(_f32),
-                            w.astype(_f32))
+        logits = self._head_logits(params, x[:, 0], "bh,vh->bv")
         return logits, pool
 
     def decode_chunk(self, params, tokens, pool, block_tables, positions,
@@ -1002,9 +1026,7 @@ class GPTModel:
             x, pool, scales = layer.decode_paged_quant(
                 lp, x, pool, scales, li, block_tables, positions)
         x = self.final_layernorm(params["final_layernorm"], x)
-        w = params["embedding"]["weight"]
-        logits = jnp.einsum("bh,vh->bv", x[:, 0].astype(_f32),
-                            w.astype(_f32))
+        logits = self._head_logits(params, x[:, 0], "bh,vh->bv")
         return logits, pool, scales
 
     def decode_chunk_quant(self, params, tokens, pool, scales,
@@ -1108,7 +1130,27 @@ def shard_params_for_tp(cfg: GPTConfig, params, rank: int):
         per = w.shape[1] // t
         return w[:, rank * per:(rank + 1) * per]
 
-    out = {"embedding": {"weight": col(params["embedding"]["weight"])},
+    def colg(g):     # Column group: weight/bias/scale all row-sharded.
+        # Per-output-channel scales ride the same dim-0 slice, which is
+        # why quantize-then-shard == shard-then-quantize bitwise here
+        out = {"weight": col(g["weight"])}
+        if "bias" in g:
+            out["bias"] = col(g["bias"])
+        if "weight_scale" in g:
+            out["weight_scale"] = col(g["weight_scale"])
+        return out
+
+    def rowg(g):     # Row group: weight column-sharded; bias and the
+        # per-OUTPUT-row scales are replicated (the scale dim is not
+        # the sharded dim)
+        out = {"weight": row(g["weight"])}
+        if "bias" in g:
+            out["bias"] = g["bias"]
+        if "weight_scale" in g:
+            out["weight_scale"] = g["weight_scale"]
+        return out
+
+    out = {"embedding": colg(params["embedding"]),
            "final_layernorm": params["final_layernorm"],
            "layers": []}
     if "position_embedding" in params:
@@ -1123,21 +1165,68 @@ def shard_params_for_tp(cfg: GPTConfig, params, rank: int):
                    "w2": lp["mlp"]["w2"][:, rank * fl:(rank + 1) * fl, :]}
         else:
             mlp = {
-                "fc1": {"weight": col(lp["mlp"]["fc1"]["weight"]),
-                        "bias": col(lp["mlp"]["fc1"]["bias"])},
-                "fc2": {"weight": row(lp["mlp"]["fc2"]["weight"]),
-                        "bias": lp["mlp"]["fc2"]["bias"]},
+                "fc1": colg(lp["mlp"]["fc1"]),
+                "fc2": rowg(lp["mlp"]["fc2"]),
             }
         out["layers"].append({
             "input_layernorm": lp["input_layernorm"],
             "post_attention_layernorm": lp["post_attention_layernorm"],
             "attention": {
-                "qkv": {"weight": col(lp["attention"]["qkv"]["weight"]),
-                        "bias": col(lp["attention"]["qkv"]["bias"])},
-                "proj": {"weight": row(lp["attention"]["proj"]["weight"]),
-                         "bias": lp["attention"]["proj"]["bias"]},
+                "qkv": colg(lp["attention"]["qkv"]),
+                "proj": rowg(lp["attention"]["proj"]),
             },
             "mlp": mlp,
+        })
+    return out
+
+
+def quantize_decode_params(params):
+    """Quantize a GPT param tree for the int8 decode path
+    (``GPTConfig(weight_quant="int8")``) — run ONCE at inference-engine
+    init, never per step.
+
+    Every dense GEMM weight — ``embedding.weight`` (the gather *and*
+    the tied lm-head), each layer's ``qkv``/``proj``/``fc1``/``fc2`` —
+    becomes ``{"weight": int8, "weight_scale": f32-per-output-row}``
+    via :func:`apex_tpu.ops.quant_gemm.quantize_weight`; biases,
+    LayerNorms and the (tiny, gather-only) position embedding stay in
+    their original dtype.  A pure function of the weight values, so
+    the quantized tree is bitwise-deterministic across loads.
+
+    TP composes per shard: the tree may already be the local shard
+    from :func:`shard_params_for_tp` — per-output-channel scales make
+    quantization commute bitwise with the ColumnParallel/vocab row
+    slices, and RowParallel column slices only tighten the per-shard
+    scale (local amax <= full amax), never loosen the error bound.
+    """
+    from apex_tpu.ops.quant_gemm import quantize_weight
+
+    def q(group):
+        w8, scale = quantize_weight(group["weight"])
+        out = dict(group)
+        out["weight"] = w8
+        out["weight_scale"] = scale
+        return out
+
+    out = {"embedding": q(params["embedding"]),
+           "final_layernorm": params["final_layernorm"],
+           "layers": []}
+    if "position_embedding" in params:
+        out["position_embedding"] = params["position_embedding"]
+    for lp in params["layers"]:
+        if "gate" in lp["mlp"]:
+            raise ValueError(
+                "quantize_decode_params covers dense GPT trees; this "
+                "tree has MoE expert stacks (mlp.gate) — "
+                "GPTConfig(weight_quant=...) rejects n_experts > 0 for "
+                "the same reason")
+        out["layers"].append({
+            "input_layernorm": lp["input_layernorm"],
+            "post_attention_layernorm": lp["post_attention_layernorm"],
+            "attention": {"qkv": q(lp["attention"]["qkv"]),
+                          "proj": q(lp["attention"]["proj"])},
+            "mlp": {"fc1": q(lp["mlp"]["fc1"]),
+                    "fc2": q(lp["mlp"]["fc2"])},
         })
     return out
 
@@ -1489,6 +1578,12 @@ def pipeline_step(model: GPTModel, params, tokens, targets, *,
         pipeline_schedule_step)
 
     cfg = model.cfg
+    if cfg.weight_quant is not None:
+        raise ValueError(
+            f"weight_quant={cfg.weight_quant!r} is a decode/prefill-only "
+            "knob: pipeline_step builds gradients, and int8 weights have "
+            "none — train with weight_quant=None and let the inference "
+            "engine quantize at init (quantize_decode_params)")
     if cfg.axis_name is not None and not cfg.sequence_parallel:
         raise ValueError(
             "pipeline_step under tensor parallelism requires "

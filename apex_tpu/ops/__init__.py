@@ -1,5 +1,7 @@
 """Low-level fused ops (Pallas kernels with jnp fallbacks)."""
 
-from apex_tpu.ops import layer_norm, multi_tensor, rope, softmax, xentropy
+from apex_tpu.ops import (layer_norm, multi_tensor, quant_gemm, rope,
+                          softmax, xentropy)
 
-__all__ = ["layer_norm", "multi_tensor", "rope", "softmax", "xentropy"]
+__all__ = ["layer_norm", "multi_tensor", "quant_gemm", "rope", "softmax",
+           "xentropy"]

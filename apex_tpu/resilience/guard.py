@@ -225,6 +225,15 @@ class GuardedTrainStep:
     def __call__(self, params, opt_state, guard_state: GuardState, *batch,
                  scaler_state=None, step: Optional[int] = None
                  ) -> StepResult:
+        if any(getattr(l, "dtype", None) == jnp.int8
+               for l in jax.tree_util.tree_leaves(params)):
+            raise ValueError(
+                "params contain int8 leaves — a weight_quant='int8' "
+                "decode tree (quantize_decode_params output). "
+                "GuardedTrainStep differentiates and updates f32/bf16 "
+                "master weights; quantization is inference-engine-init "
+                "only.  Train on the unquantized tree and set "
+                "weight_quant on the serving GPTConfig instead")
         if (self.scaler is None) != (scaler_state is None):
             raise ValueError("scaler_state must be passed iff the guard "
                              "was built with a scaler")

@@ -61,7 +61,8 @@ def linear_with_grad_accumulation_and_async_allreduce(
         async_grad_allreduce: bool = True,
         sequence_parallel_enabled: bool = False,
         axis_name: Optional[str] = TENSOR_AXIS,
-        seq_dim: int = 0, overlap_chunks: int = 0):
+        seq_dim: int = 0, overlap_chunks: int = 0,
+        weight_scale=None):
     """Column-parallel matmul with the apex collective pairing.
 
     ``async_grad_allreduce`` is parity-only: the input-grad allreduce /
@@ -98,6 +99,16 @@ def linear_with_grad_accumulation_and_async_allreduce(
                                                        seq_dim)
         else:
             x = M.copy_to_tensor_model_parallel_region(x, axis_name)
+    if weight_scale is not None:
+        # int8 decode weights (GPTConfig.weight_quant="int8"): the
+        # fused dequant-GEMM replaces the activation-dtype matmul;
+        # per-rank weight shards carry per-shard scales, so no
+        # collective changes
+        from apex_tpu.ops.quant_gemm import quant_gemm
+        y = quant_gemm(x, weight, weight_scale).astype(x.dtype)
+        if bias is not None:
+            y = y + bias.astype(y.dtype)
+        return y
     # compute at the ACTIVATION dtype (Megatron bf16 training keeps fp32
     # params as masters; the GEMM runs half).  Without the cast a bf16
     # activation silently promotes the whole GEMM to f32 — wrong dtype
@@ -181,7 +192,8 @@ class ColumnParallelLinear:
             None if self.skip_bias_add else bias,
             sequence_parallel_enabled=self.sequence_parallel_enabled,
             axis_name=self.axis_name, seq_dim=self.seq_dim,
-            overlap_chunks=self.overlap_chunks)
+            overlap_chunks=self.overlap_chunks,
+            weight_scale=params.get("weight_scale"))
         if self.gather_output and self.axis_name is not None:
             y = M.gather_from_tensor_model_parallel_region(y, self.axis_name)
         if self.skip_bias_add:
@@ -263,9 +275,18 @@ class RowParallelLinear:
             if bias is not None:
                 y = y + bias.astype(y.dtype)
             return y, None
-        # activation-dtype GEMM (see
-        # linear_with_grad_accumulation_and_async_allreduce)
-        y = x @ params["weight"].astype(x.dtype).T
+        if "weight_scale" in params:
+            # int8 decode weights: the column-sharded input contracts
+            # against a per-shard-quantized weight; the psum/
+            # reduce-scatter below is unchanged (dequantization is
+            # per-rank-local)
+            from apex_tpu.ops.quant_gemm import quant_gemm
+            y = quant_gemm(x, params["weight"],
+                           params["weight_scale"]).astype(x.dtype)
+        else:
+            # activation-dtype GEMM (see
+            # linear_with_grad_accumulation_and_async_allreduce)
+            y = x @ params["weight"].astype(x.dtype).T
         if self.axis_name is not None:
             if self.sequence_parallel_enabled:
                 y = M.reduce_scatter_to_sequence_parallel_region(
@@ -326,15 +347,27 @@ class VocabParallelEmbedding:
 
     def __call__(self, params, token_ids):
         w = params["weight"]
+        scale = params.get("weight_scale")
+
+        def deq(rows, ids):
+            # per-row dequantization of the GATHERED rows — bitwise
+            # identical to gathering the dequantized table (the scale
+            # multiply is elementwise per vocab row), without ever
+            # materializing the f32 table
+            if scale is None:
+                return rows
+            return rows.astype(_f32) * jnp.take(scale, ids,
+                                                axis=0)[..., None]
+
         if self.axis_name is None:
-            return jnp.take(w, token_ids, axis=0)
+            return deq(jnp.take(w, token_ids, axis=0), token_ids)
         rank = jax.lax.axis_index(self.axis_name)
         per = self.num_embeddings_per_partition
         start = rank * per
         local = token_ids - start
         in_range = (local >= 0) & (local < per)
         local = jnp.where(in_range, local, 0)
-        emb = jnp.take(w, local, axis=0)
+        emb = deq(jnp.take(w, local, axis=0), local)
         emb = jnp.where(in_range[..., None], emb, 0.0)
         return M.reduce_from_tensor_model_parallel_region(emb,
                                                           self.axis_name)

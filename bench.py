@@ -1658,7 +1658,7 @@ def bench_serving_disagg():
     """Disaggregated-serving leg (ISSUE 16): the two-pool fleet and the
     quantized KV cache against the single-engine arms.
 
-    Four arms over an identical request set (8 requests, half sharing
+    Five arms over an identical request set (8 requests, half sharing
     one 32-token system prompt, 24 new tokens each):
 
     * ``contiguous`` — the slot-ring engine (KV bytes/user is the full
@@ -1669,7 +1669,12 @@ def bench_serving_disagg():
     * ``disagg`` — a 1-prefill + 1-decode :class:`DisaggregatedFleet`
       on a virtual clock, f32 KV blocks over the handoff channel;
     * ``disagg_int8`` — the same fleet on the int8 scale-per-block
-      :class:`QuantizedPagedKVCache`.
+      :class:`QuantizedPagedKVCache`;
+    * ``disagg_int8_weights`` — int8 KV *and* int8 decode weights
+      (``GPTConfig(weight_quant="int8")``): every replica quantizes
+      its param tree once at init and decodes through the fused
+      dequant-GEMM, reported with weight HBM bytes per replica and
+      the kv+weight bytes each concurrent user pays.
 
     Reported per arm: wall tokens/s, KV bytes per user (measured from
     the live cache buffers, not the spec), token agreement vs the paged
@@ -1706,21 +1711,25 @@ def bench_serving_disagg():
     def sched():
         return TickScheduler(token_budget=64, min_chunk=16, max_chunk=32)
 
-    def paged_engine(clock, quant=None, prefill_only=False):
+    # int8-weight fleet arm: same f32 params in, the engine quantizes
+    # once at init off the config knob
+    qmodel = GPTModel(dataclasses.replace(cfg, weight_quant="int8"))
+
+    def paged_engine(clock, quant=None, prefill_only=False, m=None):
         return PagedInferenceEngine(
-            model, params, max_slots=4, block_size=16,
+            m or model, params, max_slots=4, block_size=16,
             chunked_prefill=True, scheduler=sched(), kv_quant=quant,
             prefill_only=prefill_only,
             metrics=ServingMetrics(clock), clock=clock)
 
-    def fleet_arm(quant):
+    def fleet_arm(quant, m=None):
         clock = VirtualClock()
         # a 4-slot decode pool stays full for a whole 24-token decode:
         # let buffered handoffs wait for capacity instead of falling
         # back to re-prefill, so every request ships over the channel
         fleet = DisaggregatedFleet(
-            [paged_engine(clock, quant, prefill_only=True)],
-            [paged_engine(clock, quant)], clock=clock,
+            [paged_engine(clock, quant, prefill_only=True, m=m)],
+            [paged_engine(clock, quant, m=m)], clock=clock,
             handoff_retry_ticks=64)
         return fleet, clock
 
@@ -1792,12 +1801,15 @@ def bench_serving_disagg():
 
     # -- disaggregated arms ----------------------------------------------
     handoff_bytes = {}
-    for name, quant in (("disagg", None), ("disagg_int8", "int8")):
-        f0, c0 = fleet_arm(quant)
+    weight_bytes = {}
+    for name, quant, m in (("disagg", None, None),
+                           ("disagg_int8", "int8", None),
+                           ("disagg_int8_weights", "int8", qmodel)):
+        f0, c0 = fleet_arm(quant, m)
         drive_fleet(f0, c0)                    # compile untimed
 
-        def timed(quant=quant):
-            fleet, clock = fleet_arm(quant)
+        def timed(quant=quant, m=m):
+            fleet, clock = fleet_arm(quant, m)
             t0 = time.perf_counter()
             toks, n = drive_fleet(fleet, clock)
             dt = time.perf_counter() - t0
@@ -1807,12 +1819,20 @@ def bench_serving_disagg():
             arms[name] = None
             continue
         toks, n, dt, fleet, clock = got
-        pool = fleet.decode.replicas[0].pool
+        eng = fleet.decode.replicas[0]
+        pool = eng.pool
         handoff_bytes[name] = fleet.channel.handoff_bytes
+        weight_bytes[name] = eng.weight_bytes
+        kv_per_user = paged_bytes_per_user(pool)
         arms[name] = {
             "tokens": n, "window_s": round(dt, 6),
             "tokens_per_s": round(n / dt, 2),
-            "kv_bytes_per_user": round(paged_bytes_per_user(pool), 1),
+            "kv_bytes_per_user": round(kv_per_user, 1),
+            "weight_bytes_per_replica": eng.weight_bytes,
+            # weights amortize over the replica's concurrent users
+            # (max_slots); KV is per user outright
+            "kv_plus_weight_bytes_per_user": round(
+                kv_per_user + eng.weight_bytes / 4, 1),
             "token_agreement": round(agreement(toks), 4),
             "handoffs": fleet.handoffs,
             "fallbacks": fleet.fallbacks,
@@ -1824,7 +1844,14 @@ def bench_serving_disagg():
         ratio = round(handoff_bytes["disagg_int8"]
                       / handoff_bytes["disagg"], 4)
         assert ratio < 0.30, f"int8 handoff ratio {ratio} >= 0.30"
-    return {"arms": arms, "int8_handoff_byte_ratio": ratio}
+    wratio = None
+    if weight_bytes.get("disagg") and weight_bytes.get("disagg_int8_weights"):
+        wratio = round(weight_bytes["disagg_int8_weights"]
+                       / weight_bytes["disagg"], 4)
+        assert wratio < 0.30, \
+            f"int8 weight byte ratio {wratio} >= 0.30"
+    return {"arms": arms, "int8_handoff_byte_ratio": ratio,
+            "int8_weight_byte_ratio": wratio}
 
 
 def bench_lint():
@@ -2010,12 +2037,17 @@ def bench_fused_ffn():
     t_fused = _time_steps(grad_of(fused_ffn), args,
                           warmup=2, iters=8, rounds=3)
     jax.clear_caches()
-    return {"tokens": m, "hidden": h, "ffn_hidden": f,
-            "dtype": "bfloat16",
-            "path": "pallas" if use_pallas() else "reference",
-            "unfused_s": round(t_unfused, 6),
-            "fused_s": round(t_fused, 6),
-            "speedup": round(t_unfused / t_fused, 4)}
+    out = {"tokens": m, "hidden": h, "ffn_hidden": f,
+           "dtype": "bfloat16",
+           "path": "pallas" if use_pallas() else "reference",
+           "unfused_s": round(t_unfused, 6),
+           "fused_s": round(t_fused, 6)}
+    # off-TPU both arms run the same unfused reference, so the ratio is
+    # pure dispatch noise — record it under an ``_advisory`` key so
+    # bench_diff never flags a phantom regression on CPU rounds
+    key = "speedup" if use_pallas() else "speedup_advisory"
+    out[key] = round(t_unfused / t_fused, 4)
+    return out
 
 
 def bench_mfu_multichip():
@@ -2027,10 +2059,19 @@ def bench_mfu_multichip():
     subprocess pinned to the host platform (this process owns the TPU;
     the tool owns its mesh — the ``bench_autotune`` idiom).  The MFU
     denominator is the same calibrated matmul roofline the planner
-    ranks with, so the fraction is honest on CPU hosts too."""
+    ranks with, so the fraction is honest on CPU hosts too — but on a
+    CPU host that calibration drifts double-digit percent run-to-run
+    with machine load, so the ratio is incomparable across rounds
+    (r07->r08 measured achieved-flops UP 20% while "mfu" fell 11%
+    purely on a faster calibration): off-TPU the ``mfu`` keys are
+    recorded as ``mfu_advisory`` so bench_diff never flags a phantom
+    regression; the achieved-flops and predicted-vs-measured ``gap``
+    series remain the gated trend."""
     import subprocess
     import sys
     import tempfile
+
+    from apex_tpu.utils import use_pallas
 
     script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "tools", "mfu_multichip.py")
@@ -2049,6 +2090,11 @@ def bench_mfu_multichip():
                 f"{out.stderr[-1500:]}")
         with open(out_path) as f:
             report = json.load(f)
+    if not use_pallas():
+        report["mfu_advisory"] = report.pop("mfu", None)
+        for row in report.get("rows", {}).values():
+            if "mfu" in row:
+                row["mfu_advisory"] = row.pop("mfu")
     report["total_wall_s"] = round(time.perf_counter() - t0, 3)
     return report
 

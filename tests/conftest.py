@@ -24,6 +24,16 @@ if not ON_CHIP:
     if "xla_force_host_platform_device_count" not in flags:
         os.environ["XLA_FLAGS"] = (
             flags + " --xla_force_host_platform_device_count=8").strip()
+    # Persistent XLA compilation cache: the CI host has one CPU core and
+    # the suite is compile-bound, so warm reruns of the tier-1 command
+    # drop well under its time budget.  Env vars (not config.update) so
+    # the example-script subprocesses in test_examples.py inherit it.
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                     os.pardir, ".jax_cache")))
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                          "0.5")
 
 import jax  # noqa: E402
 

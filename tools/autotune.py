@@ -104,6 +104,19 @@ def _divisors(n: int) -> List[int]:
     return [d for d in range(1, n + 1) if n % d == 0]
 
 
+def _reject_weight_quant(cfg_kw: dict) -> None:
+    """The autotuner enumerates TRAINING plans — every candidate is a
+    compiled grad step (build_train_step -> pipeline_step), which int8
+    decode weights cannot feed.  Reject at the door with the fix."""
+    if cfg_kw.get("weight_quant") is not None:
+        raise ValueError(
+            f"cfg_kw['weight_quant']={cfg_kw['weight_quant']!r}: the "
+            "autotune space is training plans (pipeline_step grad "
+            "builds), and weight_quant is decode/prefill-only — drop it "
+            "from cfg_kw here and set it on the serving GPTConfig, "
+            "where the inference engine quantizes at init")
+
+
 def enumerate_space(n_devices: int, *, n_layers: int, n_heads: int,
                     batch: int, seq: int, max_tp: Optional[int] = None,
                     max_pp: Optional[int] = None, zero: bool = True,
@@ -515,6 +528,7 @@ def autotune_mpmd(n_devices: int, *, cfg_kw: Optional[dict] = None,
             print(msg, flush=True)
 
     cfg_kw = dict(cfg_kw or DEFAULT_MODEL)
+    _reject_weight_quant(cfg_kw)
     seq = seq if seq is not None else cfg_kw["max_seq_len"]
     if cost_model is None and dcn is None:
         say("no comms profile or --dcn given; probing ici in-process")
@@ -599,6 +613,7 @@ def autotune(n_devices: int, *, cfg_kw: Optional[dict] = None,
             print(msg, flush=True)
 
     cfg_kw = dict(cfg_kw or DEFAULT_MODEL)
+    _reject_weight_quant(cfg_kw)
     seq = seq if seq is not None else cfg_kw["max_seq_len"]
     devices = (list(devices) if devices is not None
                else jax.devices()[:n_devices])

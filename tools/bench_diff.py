@@ -50,6 +50,11 @@ LOWER_BETTER = ("overhead", "bubble", "ttft", "tpot", "latency",
 def direction(key: str) -> int:
     """+1 higher-better, -1 lower-better, 0 unknown."""
     k = key.lower()
+    # ``*_advisory`` keys are informational (e.g. the off-TPU fused-FFN
+    # "speedup" where both arms run the same reference): never a
+    # regression signal, whatever substring they carry
+    if k.endswith("_advisory"):
+        return 0
     for pat in HIGHER_BETTER:
         if pat in k:
             return 1

@@ -69,7 +69,13 @@ SLO burn are deterministic on any host:
   while the others run short interactive traffic; GATES on the
   exactly-once ledger and per-TENANT SLO attainment ≥ 0.9 — the
   long-context tenant must not starve the short ones of first tokens
-  (that isolation is the point of a separate prefill pool).
+  (that isolation is the point of a separate prefill pool);
+* ``disagg_quant`` — the ``disagg_diurnal`` mixed day (same workload,
+  same mid-day pool flip) on the fully-quantized stack: int8 decode
+  weights (``GPTConfig(weight_quant="int8")``, every replica
+  quantizes once at init) × int8 KV blocks over the handoff channel;
+  GATES on the exactly-once ledger and per-phase SLO attainment
+  ≥ 0.9 — quantization must not cost a response or an SLO.
 
 Every scenario report carries the exactly-once ledger (``submitted`` /
 ``lost`` / ``duplicated``), per-outcome counts, SLO attainment over the
@@ -100,9 +106,15 @@ import jax            # noqa: E402
 import numpy as np    # noqa: E402
 
 SCENARIOS = ("steady", "replica_kill", "slow_replica", "diurnal", "bursty",
-             "capacity_diurnal", "disagg_diurnal", "disagg_longctx_fair")
+             "capacity_diurnal", "disagg_diurnal", "disagg_longctx_fair",
+             "disagg_quant")
 
-DISAGG_SCENARIOS = ("disagg_diurnal", "disagg_longctx_fair")
+DISAGG_SCENARIOS = ("disagg_diurnal", "disagg_longctx_fair",
+                    "disagg_quant")
+
+# scenarios that run the disagg_diurnal mixed-day workload (and its
+# mid-day pool flip)
+_DIURNAL_MIX = ("disagg_diurnal", "disagg_quant")
 
 
 def _pct(xs, q):
@@ -112,10 +124,12 @@ def _pct(xs, q):
 def _build_model(args):
     from apex_tpu.models.gpt import GPTConfig, GPTModel
 
+    wq = getattr(args, "weight_quant", None)
     cfg = GPTConfig(vocab_size=args.vocab, hidden_size=args.hidden,
                     num_layers=args.layers,
                     num_attention_heads=args.heads,
-                    max_seq_len=args.max_seq)
+                    max_seq_len=args.max_seq,
+                    weight_quant=None if wq in (None, "none") else wq)
     model = GPTModel(cfg)
     params = model.init_params(jax.random.PRNGKey(0))
     return model, params
@@ -567,7 +581,7 @@ def synthesize_disagg(args):
     cap = args.max_seq - args.max_new - 1
     for i in range(n):
         t += float(rng.exponential(1.0 / args.rate))
-        if args.scenario == "disagg_diurnal":
+        if args.scenario in _DIURNAL_MIX:
             heavy = i < n // 2
             tag = "prefill_heavy" if heavy else "decode_heavy"
             base = args.min_prompt * 4 if heavy else args.min_prompt
@@ -599,6 +613,11 @@ def run_disagg_scenario(args) -> dict:
     from apex_tpu.observability import FleetCollector
     from apex_tpu.serving import RequestShed, VirtualClock
 
+    if args.scenario == "disagg_quant":
+        # the fully-quantized serving arm: int8 decode weights x int8
+        # KV blocks over the same mixed day as disagg_diurnal
+        args.kv_quant = "int8"
+        args.weight_quant = "int8"
     clock = VirtualClock()
     fleet, controller = build_disagg_fleet(args, clock)
     work = synthesize_disagg(args)
@@ -616,7 +635,7 @@ def run_disagg_scenario(args) -> dict:
     shift_requested = False
     while True:
         now = clock()
-        if args.scenario == "disagg_diurnal" and not shift_requested \
+        if args.scenario in _DIURNAL_MIX and not shift_requested \
                 and now >= mid_t:
             # the mid-day flip: decode-heavy afternoon needs the chip
             # more than the now-quiet prefill pool does
@@ -693,6 +712,8 @@ def run_disagg_scenario(args) -> dict:
         "handoffs": fleet.handoffs,
         "fallbacks": fleet.fallbacks,
         "handoff_bytes": fleet.channel.handoff_bytes,
+        "weight_bytes_per_replica":
+            fleet.decode.replicas[0].weight_bytes,
         "pool_split": controller.split,
         "pool_shifts": controller.stats["shifts"],
         "capacity_audit": audit,
@@ -760,6 +781,10 @@ def main(argv=None) -> int:
     # disaggregated scenarios
     ap.add_argument("--prefill-replicas", type=int, default=2)
     ap.add_argument("--decode-replicas", type=int, default=2)
+    ap.add_argument("--weight-quant", choices=("none", "int8"),
+                    default="none",
+                    help="int8 decode weights (GPTConfig.weight_quant); "
+                         "disagg_quant forces int8")
     ap.add_argument("--kv-quant", choices=("none", "int8"),
                     default="none",
                     help="decode+prefill pool KV cache storage")
