@@ -29,6 +29,20 @@ Three pieces:
   once per machine is reusable across runs — and refused when the
   schema moved on.
 
+Online refits (ROADMAP item 3): a saved profile describes the machine
+at probe time, and machines drift — links degrade, routes change,
+neighbors appear.  :meth:`CostModel.update` buffers fresh production
+measurements (collective stats, channel timings, per-request traces)
+and :meth:`CostModel.refit` fits them into a refreshed model, with a
+:meth:`CostModel.drift_report` comparing the new curves against the
+loaded profile — the signal
+:class:`~apex_tpu.resilience.autopilot.ParallelismAutopilot` debounces
+before re-ranking plans.  Profiles are stamped with their probe
+wall-time and measurement count (``meta["probed_at"]`` /
+``meta["n_measurements"]``) so :meth:`CostModel.profile_age` /
+:meth:`CostModel.is_stale` can distinguish "drifted" from "never
+probed on this fleet".
+
 Two-tier fabrics (MPMD cross-pod pipelines, ``apex_tpu.mpmd``): every
 measurement and fit carries a ``link_class`` — ``"ici"`` for the
 intra-pod interconnect, ``"dcn"`` for the inter-pod network — probed
@@ -51,6 +65,7 @@ import dataclasses
 import json
 import math
 import time
+import warnings
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 PROFILE_VERSION = 1
@@ -224,6 +239,9 @@ class CostModel:
             self._by_class.setdefault(str(lc), {})[(op, dtype)] = fit
         self._by_class.setdefault("ici", {})
         self.meta = dict(meta or {})
+        # fresh production measurements buffered by update(), consumed
+        # (and cleared) by a successful refit()
+        self._fresh: List[Measurement] = []
 
     @property
     def fits(self) -> Dict[Tuple[str, str], CostFit]:
@@ -239,6 +257,112 @@ class CostModel:
         return {(op, dtype, lc): fit
                 for lc in sorted(self._by_class)
                 for (op, dtype), fit in sorted(self._by_class[lc].items())}
+
+    # -- staleness -----------------------------------------------------------
+
+    def profile_age(self, now: Optional[float] = None) -> Optional[float]:
+        """Seconds since the profile was probed (``meta["probed_at"]``
+        wall time, stamped by :meth:`save` and :meth:`refit`), or None
+        for profiles that never carried the stamp."""
+        probed = self.meta.get("probed_at")
+        if probed is None:
+            return None
+        t = time.time() if now is None else now
+        return max(0.0, float(t) - float(probed))
+
+    def is_stale(self, max_age_s: float,
+                 now: Optional[float] = None) -> bool:
+        """True when the profile is older than ``max_age_s`` — or never
+        carried a probe stamp at all ("never probed on this fleet" is
+        stale by definition; "drifted" is a separate, measured signal —
+        see :meth:`drift_report`)."""
+        age = self.profile_age(now)
+        return age is None or age > float(max_age_s)
+
+    # -- online refits -------------------------------------------------------
+
+    def update(self, measurements: Iterable[Measurement]) -> int:
+        """Buffer fresh production measurements (collective timings from
+        channels, traces, probes) for a later :meth:`refit`; returns the
+        buffered count.  Cheap and non-blocking: nothing is fitted until
+        refit() decides there is enough data."""
+        self._fresh.extend(measurements)
+        return len(self._fresh)
+
+    @property
+    def fresh_measurements(self) -> Tuple[Measurement, ...]:
+        """The measurements buffered by :meth:`update` and not yet
+        consumed by a successful :meth:`refit`."""
+        return tuple(self._fresh)
+
+    def drift_report(self, other: "CostModel",
+                     group_size: int = 4) -> dict:
+        """Relative drift of ``other``'s fitted curves vs this profile.
+
+        Per shared (op, dtype, link_class) curve: the worst
+        ``|t_other / t_self - 1|`` over a small probe grid of payload
+        sizes — a pure function of the alpha-beta movement that weighs
+        the coefficients the way the planner does (by predicted time),
+        so a latency curve whose unused beta wiggles does not read as
+        drift.  Returns ``{"curves": {key: drift}, "max_drift",
+        "n_shared"}``; curves only one side fitted are skipped (no
+        basis for comparison).
+        """
+        mine, theirs = self.curves(), other.curves()
+        rows: Dict[str, float] = {}
+        worst = 0.0
+        for key in sorted(set(mine) & set(theirs)):
+            op = key[0]
+            deltas = []
+            for nb in (1 << 12, 1 << 16, 1 << 20):
+                t0 = mine[key].predict(op, nb, group_size)
+                t1 = theirs[key].predict(op, nb, group_size)
+                if t0 > 0.0:
+                    deltas.append(abs(t1 / t0 - 1.0))
+                elif t1 > 0.0:
+                    deltas.append(math.inf)
+            d = max(deltas, default=0.0)
+            rows["|".join(key)] = d
+            worst = max(worst, d)
+        return {"curves": rows, "max_drift": worst,
+                "n_shared": len(rows)}
+
+    def refit(self, min_measurements: int = 8,
+              meta: Optional[dict] = None,
+              now: Optional[float] = None) -> dict:
+        """Fit the buffered :meth:`update` measurements into a REFRESHED
+        model and report how far it drifted from this one.
+
+        Returns ``{"refitted", "reason", "n", "model", "drift"}``.  With
+        fewer than ``min_measurements`` buffered points the refit is
+        declined (``refitted=False``, buffer kept) — a handful of noisy
+        samples must never move a plan.  On success the new model merges
+        the freshly fitted curves over this profile's remaining ones
+        (incremental update: un-remeasured tiers keep their old fits),
+        carries this profile's meta re-stamped with ``probed_at`` /
+        ``n_measurements``, and the buffer is cleared.  ``self`` is
+        NEVER mutated: the caller — the autopilot — owns adoption of the
+        refreshed model, after debouncing ``drift["max_drift"]``.
+        """
+        n = len(self._fresh)
+        if n < int(min_measurements):
+            return {"refitted": False, "n": n, "model": None,
+                    "drift": None,
+                    "reason": f"only {n} fresh measurement(s) "
+                              f"(< {min_measurements}); keeping the "
+                              "loaded profile"}
+        m = dict(self.meta)
+        m.update(meta or {})
+        m["probed_at"] = float(time.time() if now is None else now)
+        m["n_measurements"] = n
+        fitted = fit_cost_model(self._fresh, meta=m)
+        drift = self.drift_report(fitted)
+        merged = dict(self.curves())
+        merged.update(fitted.curves())
+        model = CostModel(merged, meta=m)
+        self._fresh = []
+        return {"refitted": True, "n": n, "model": model,
+                "drift": drift, "reason": ""}
 
     # -- prediction ----------------------------------------------------------
 
@@ -364,7 +488,16 @@ class CostModel:
     @classmethod
     def from_json(cls, doc: dict) -> "CostModel":
         ver = doc.get("version")
-        if ver != PROFILE_VERSION:
+        if ver is None:
+            # profiles written before versioning existed: still usable
+            # alpha-beta data, but flag it — and is_stale() will report
+            # them stale (no probed_at stamp either)
+            warnings.warn(
+                "machine profile carries no version field (written "
+                "before profiles were versioned); loading anyway — "
+                "re-run tools/comms_probe.py to refresh it",
+                stacklevel=2)
+        elif ver != PROFILE_VERSION:
             raise ValueError(
                 f"machine profile version {ver!r} != supported "
                 f"{PROFILE_VERSION}; re-run tools/comms_probe.py")
@@ -387,7 +520,13 @@ class CostModel:
              measurements: Optional[Sequence[Measurement]] = None) -> str:
         """Write the machine profile (fits + meta + optionally the raw
         measurements, so a later re-fit can improve the model without
-        re-probing)."""
+        re-probing).  Stamps staleness metadata: ``meta["probed_at"]``
+        (wall time, kept if already set — a re-save does not make old
+        data look fresh) and ``meta["n_measurements"]`` when the raw
+        points are given."""
+        self.meta.setdefault("probed_at", time.time())
+        if measurements is not None:
+            self.meta["n_measurements"] = len(measurements)
         doc = self.to_json()
         if measurements is not None:
             doc["measurements"] = [m.to_dict() for m in measurements]

@@ -28,7 +28,16 @@ Four layers, composable but independently usable:
   hysteresis + cooldown, a two-phase shift protocol with rollback, and
   ``capacity_change`` fault injection (proven by
   ``tools/day_in_life.py``).
+* :mod:`~apex_tpu.resilience.autopilot` — self-driving parallelism:
+  :class:`ParallelismAutopilot` refits the CostModel from production
+  telemetry, debounces drift, re-ranks the plan space against the
+  refreshed profile, and adopts the winner through a measured
+  baseline→drain→commit gate with rollback (``cost_drift`` /
+  ``plan_regression`` fault injection, flap-free audit).
 """
+
+from apex_tpu.resilience.autopilot import (ADOPTION_OUTCOMES,
+                                           ParallelismAutopilot)
 
 from apex_tpu.resilience.capacity import (CAPACITY_FAULT_MODES,
                                           CapacityBudget,
@@ -48,6 +57,8 @@ from apex_tpu.resilience.guard import (GuardedTrainStep, GuardState,
                                        StepResult)
 
 __all__ = [
+    "ADOPTION_OUTCOMES",
+    "ParallelismAutopilot",
     "CAPACITY_FAULT_MODES",
     "CapacityBudget",
     "CapacityController",

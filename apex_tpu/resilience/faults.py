@@ -31,13 +31,28 @@ same failure sequence on every run.  Kinds:
                     retryable :class:`apex_tpu.mpmd.DcnTimeout`; consumed
                     (recorded + removed) via :meth:`FaultInjector.check_dcn`
                     so the engine's resend succeeds
+``cost_drift``      the machine's communication profile drifts at that
+                    step: ``magnitude`` scales the true link alpha-beta
+                    coefficients (0 = default 2x slower; < 1 = links
+                    recovering); consumed via
+                    :meth:`FaultInjector.check_cost_drift` by the
+                    :class:`~apex_tpu.resilience.autopilot.ParallelismAutopilot`,
+                    which must DETECT it from refitted telemetry — the
+                    fault moves the environment, never the detector
+``plan_regression`` the next adopted plan measures slower than predicted:
+                    ``magnitude`` inflates the commit-gate step times
+                    (0 = default 2x) so the gate must roll the adoption
+                    back; consumed via
+                    :meth:`FaultInjector.check_plan_regression` when an
+                    adoption starts
 =================== =========================================================
 
 Every new kind is appended LAST so :meth:`FaultInjector.from_seed`
 schedules for the pre-existing kinds are byte-identical to before it
 existed — ``seeded_schedule`` consumes no rng state for rate-0 kinds
-(asserted by ``tests/test_capacity.py`` for ``capacity_change`` and
-``tests/test_mpmd.py`` for ``dcn_fault``).
+(asserted by ``tests/test_capacity.py`` for ``capacity_change``,
+``tests/test_mpmd.py`` for ``dcn_fault``, and
+``tests/test_autopilot.py`` for ``cost_drift``/``plan_regression``).
 
 The in-jit kinds are injected as DATA, not control flow:
 :meth:`grad_flags` returns three scalars the guarded train step folds in
@@ -55,7 +70,8 @@ import numpy as np
 
 FAULT_KINDS = ("nan_grads", "inf_loss", "grad_spike", "preempt_at_step",
                "corrupt_checkpoint", "slow_host", "topology_change",
-               "capacity_change", "dcn_fault")
+               "capacity_change", "dcn_fault", "cost_drift",
+               "plan_regression")
 
 # the serving-side fault kinds live in apex_tpu.serving.fleet
 # (SERVING_FAULT_KINDS); its ServingFaultInjector generates schedules
@@ -231,6 +247,38 @@ class FaultInjector:
             self.record(step, "dcn_fault")
             self._by_step[step].remove(f)
         return f
+
+    def _consume_due(self, step: int, kind: str) -> Optional[Fault]:
+        """The EARLIEST scheduled ``kind`` at or before ``step``, if
+        any — consumed (recorded at its scheduled step + removed).
+        Window-tolerant where :meth:`_consume` is exact-step: the
+        autopilot polls at controller ticks, which land between
+        training steps, so a fault scheduled "at step 24" must still be
+        seen when the poll happens at step 26."""
+        for s in sorted(self._by_step):
+            if s > step:
+                break
+            for f in self._by_step[s]:
+                if f.kind == kind:
+                    self.record(s, kind)
+                    self._by_step[s].remove(f)
+                    return f
+        return None
+
+    def check_cost_drift(self, step: int) -> Optional[Fault]:
+        """The scheduled ``cost_drift`` due by ``step``, if any —
+        consumed so one scheduled fault drifts the environment once.
+        ``magnitude`` scales the drifted environment's alpha-beta
+        coefficients relative to the current profile (0 = 2x)."""
+        return self._consume_due(step, "cost_drift")
+
+    def check_plan_regression(self, step: int) -> Optional[Fault]:
+        """The scheduled ``plan_regression`` due by ``step``, if any —
+        consumed at adoption start so one scheduled fault fails one
+        commit gate: the autopilot's next adoption after the rollback
+        must be able to succeed.  ``magnitude`` inflates the gate's
+        measured step times (0 = 2x)."""
+        return self._consume_due(step, "plan_regression")
 
     def maybe_slow_host(self, step: int) -> None:
         f = self._find(step, "slow_host")

@@ -1304,6 +1304,121 @@ def bench_capacity():
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_autopilot():
+    """Self-driving-parallelism leg (ROADMAP item 3): what the closed
+    drift -> refit -> re-rank -> gated-adoption loop costs.
+
+    A :class:`ParallelismAutopilot` over a FusedAdam elastic trainer
+    runs one full cycle against an injected interconnect drift: links
+    go 16x slower (``cost_drift``), the refit window confirms it and
+    the re-ranked plan commits through the measured baseline -> drain
+    -> gate protocol; the links then recover with a
+    ``plan_regression`` poisoning the re-adoption's gate, forcing the
+    measured rollback.  Reported per phase, from the autopilot's own
+    stats: ``refit_s`` (incremental cost-model refit), ``rank_s``
+    (plan-space re-rank), ``drain_s`` + ``reshard_s`` (the adoption's
+    boundary checkpoint and re-shard — the only training-visible
+    cost), and ``rollback_s`` (replan back to the stamped old plan).
+    Step times are driver-synthesized from the drifted alpha-beta
+    curve (the controller is under test, not the toy model); the
+    checkpoint/re-shard/rollback numbers are real wall time over the
+    512x256 elastic trainer."""
+    import shutil
+    import tempfile
+
+    from apex_tpu.observability import MetricsRegistry
+    from apex_tpu.observability.costmodel import (
+        CostFit, fit_cost_model, simulate_link_measurements)
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.resilience import (ElasticComponents, ElasticPlan,
+                                     ElasticTrainer, Fault, FaultInjector,
+                                     GuardedTrainStep,
+                                     ParallelismAutopilot, TopologySpec)
+
+    _free_calibration()
+    n = len(jax.devices())
+    if n < 2:
+        return {"skipped": "needs >= 2 devices"}
+    dp = 4 if n >= 4 else 2
+
+    def loss_fn(p, x, y):
+        return jnp.mean(jnp.square(x @ p["w"] + p["b"] - y))
+
+    def factory(plan, ckpt, inj):
+        opt = FusedAdam(lr=1e-3, bucketed=False)
+        guard = GuardedTrainStep(loss_fn, opt, warmup_steps=1,
+                                 checkpoint=ckpt, fault_injector=inj)
+        r = np.random.RandomState(7)
+        params = plan.put(
+            {"w": jnp.asarray((r.randn(512, 256) * 0.02).astype(np.float32)),
+             "b": jnp.zeros((256,), jnp.float32)})
+        return ElasticComponents(guard, params, opt.init(params),
+                                 guard.init_state())
+
+    def batch_fn(step, plan):
+        r = np.random.RandomState(9_000 + step)
+        return (jnp.asarray(r.randn(64, 512).astype(np.float32)),
+                jnp.asarray(r.randn(64, 256).astype(np.float32)))
+
+    alpha0, beta0 = 2e-3, 1e-9
+    grad_bytes = 512 * 256 * 4 + 256 * 4
+    serial_s = 0.12
+
+    def step_dt(step, cur_dp):
+        scale = 1.0
+        if step >= 2:
+            scale *= 16.0
+        if step >= 8:
+            scale /= 16.0
+        fit = CostFit(alpha0 * scale, beta0 * scale)
+        comm = fit.predict("psum", grad_bytes, cur_dp) if cur_dp > 1 \
+            else 0.0
+        return serial_s / cur_dp + comm
+
+    profile = fit_cost_model(
+        simulate_link_measurements(alpha0, beta0, link_class="dcn",
+                                   ops=("psum",)),
+        meta={"source": "bench_autopilot"})
+    inj = FaultInjector([Fault(2, "cost_drift", magnitude=16.0),
+                         Fault(8, "cost_drift", magnitude=1.0 / 16.0),
+                         Fault(8, "plan_regression", magnitude=4.0)])
+    root = tempfile.mkdtemp(prefix="apex_tpu_bench_autopilot_")
+    try:
+        reg = MetricsRegistry()
+        trainer = ElasticTrainer(
+            factory, ElasticPlan.build(TopologySpec(dp=dp)),
+            directory=root, save_every=1, fault_injector=inj)
+        ap = ParallelismAutopilot(
+            trainer, profile, min_dp=max(1, dp // 2),
+            link_class="dcn", confirm_windows=2, min_measurements=8,
+            cooldown_s=0.0, gate_steps=2, gate_tolerance=1.2,
+            grad_bytes=grad_bytes, injector=inj, registry=reg)
+        commit = None
+        for step in range(16):
+            trainer.step_once(batch_fn)
+            ap.record_step(step_dt(step, trainer.plan.spec.dp))
+            ap.tick()
+            ap.tick()
+            if commit is None and ap.stats["adoptions"] == 1:
+                commit = dict(ap.stats["last_adoption"])
+        assert ap.stats["adoptions"] == 1, ap.adoption_log
+        assert ap.stats["rollbacks"] == 1, ap.adoption_log
+        assert ap.audit() == [], ap.audit()
+        rollback = dict(ap.stats["last_adoption"])
+
+        rnd = lambda d: {k: (round(v, 5) if isinstance(v, float) else v)
+                         for k, v in d.items()}
+        return {"dp": dp, "shrink_dp": max(1, dp // 2),
+                "grad_bytes": grad_bytes,
+                "refit_windows": ap.stats["refits"],
+                "refit_s": round(ap.stats["last_refit_s"], 5),
+                "drift_confirmations": ap.stats["drift_confirmed"],
+                "commit": rnd(commit),
+                "rollback": rnd(rollback)}
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def bench_observability():
     """Observability leg (ISSUE 5): what monitoring costs.
 
@@ -2115,6 +2230,7 @@ def _extra_legs():
         "resilience": bench_resilience,
         "elastic": bench_elastic,
         "capacity": bench_capacity,
+        "autopilot": bench_autopilot,
         "observability": bench_observability,
         "serving_observability": bench_serving_observability,
         "serving_paged": bench_serving_paged,
@@ -2211,6 +2327,7 @@ def main(argv=None):
     resilience = _retry(bench_resilience)
     elastic = _retry(bench_elastic)
     capacity = _retry(bench_capacity)
+    autopilot = _retry(bench_autopilot)
     observability = _retry(bench_observability)
     serving_obs = _retry(bench_serving_observability)
     serving_paged = _retry(bench_serving_paged)
@@ -2248,6 +2365,7 @@ def main(argv=None):
             "resilience": resilience,
             "elastic": elastic,
             "capacity": capacity,
+            "autopilot": autopilot,
             "observability": rounded(observability),
             "serving_observability": rounded(serving_obs),
             "serving_paged": serving_paged,

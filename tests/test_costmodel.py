@@ -14,7 +14,17 @@ The contract under test (ISSUE 7):
 * ``validate`` reports the worst two-sided ratio; ``holdout_split``
   never holds out a curve's endpoints;
 * the profile JSON round-trips fits + measurements and refuses a
-  version it doesn't understand.
+  version it doesn't understand — while a version-LESS (pre-stamp)
+  profile still loads, with a warning;
+* ``save`` stamps staleness metadata (``probed_at`` +
+  ``n_measurements``) and ``profile_age`` / ``is_stale`` gate on it —
+  a never-stamped profile is always stale;
+* the incremental refit path (ROADMAP item 3): ``update`` buffers
+  without fitting, ``refit`` declines below ``min_measurements`` and
+  KEEPS the buffer, recovers a planted drift in its ``drift_report``,
+  merges un-remeasured curves from the old model, never mutates
+  ``self``, and its fits stay within the two-sided ``validate`` ratio
+  on a held-out split.
 
 The probe itself (device timing) runs in ``__graft_entry__``'s
 ``_dryrun_costmodel`` leg on the multi-device CPU mesh — tier-1 runs
@@ -39,6 +49,7 @@ from apex_tpu.observability.costmodel import (
     load_profile,
     ring_hops,
     ring_wire_bytes,
+    simulate_link_measurements,
 )
 
 
@@ -232,3 +243,128 @@ class TestProfileJson:
         model.save(path)
         _, ms = load_profile(path)
         assert ms == []
+
+    def test_versionless_profile_loads_with_warning(self, tmp_path):
+        model = fit_cost_model(
+            synthetic("psum", "f32", 1e-6, 2e-9, (4096, 65536)))
+        doc = model.to_json()
+        del doc["version"]
+        path = tmp_path / "prehistoric.json"
+        path.write_text(json.dumps(doc))
+        with pytest.warns(UserWarning, match="no version"):
+            loaded, _ = load_profile(str(path))
+        assert loaded.predict("psum", 4096, 2) \
+            == model.predict("psum", 4096, 2)
+
+
+class TestStaleness:
+    def test_save_stamps_probe_metadata(self, tmp_path):
+        ms = synthetic("psum", "f32", 1e-6, 2e-9, (4096, 65536))
+        model = fit_cost_model(ms)
+        path = str(tmp_path / "profile.json")
+        model.save(path, measurements=ms)
+        loaded, _ = load_profile(path)
+        assert loaded.meta["n_measurements"] == len(ms)
+        t0 = loaded.meta["probed_at"]
+        assert loaded.profile_age(now=t0 + 10.0) == pytest.approx(10.0)
+        assert not loaded.is_stale(3600.0, now=t0 + 10.0)
+        assert loaded.is_stale(3600.0, now=t0 + 7200.0)
+
+    def test_existing_stamp_not_overwritten(self, tmp_path):
+        ms = synthetic("psum", "f32", 1e-6, 2e-9, (4096, 65536))
+        model = fit_cost_model(ms, meta={"probed_at": 1234.5})
+        path = str(tmp_path / "profile.json")
+        model.save(path, measurements=ms)
+        loaded, _ = load_profile(path)
+        assert loaded.meta["probed_at"] == 1234.5
+
+    def test_never_stamped_always_stale(self):
+        model = fit_cost_model(
+            synthetic("psum", "f32", 1e-6, 2e-9, (4096, 65536)))
+        assert model.profile_age() is None
+        assert model.is_stale(1e18)     # any gate: no stamp => stale
+
+
+class TestRefit:
+    def _base(self):
+        return fit_cost_model(
+            simulate_link_measurements(1e-6, 1e-9, link_class="ici",
+                                       ops=("psum",))
+            + simulate_link_measurements(2e-3, 1e-9, link_class="dcn",
+                                         ops=("psum",)))
+
+    def test_update_buffers_without_fitting(self):
+        model = self._base()
+        before = dict(model.curves())
+        n = model.update(simulate_link_measurements(
+            2e-6, 2e-9, link_class="ici", ops=("psum",)))
+        assert n == len(model.fresh_measurements) > 0
+        assert dict(model.curves()) == before   # nothing fitted yet
+
+    def test_too_few_declines_and_keeps_buffer(self):
+        model = self._base()
+        pts = simulate_link_measurements(
+            2e-6, 2e-9, link_class="ici", ops=("psum",))[:3]
+        model.update(pts)
+        res = model.refit(min_measurements=8)
+        assert not res["refitted"]
+        assert "3" in res["reason"]
+        assert len(model.fresh_measurements) == 3   # buffer KEPT
+        # topping up past the floor succeeds and clears the buffer
+        model.update(simulate_link_measurements(
+            2e-6, 2e-9, link_class="ici", ops=("psum",)))
+        assert model.refit(min_measurements=8)["refitted"]
+        assert model.fresh_measurements == ()
+
+    def test_recovers_planted_drift(self):
+        model = self._base()
+        model.update(simulate_link_measurements(
+            2e-6, 2e-9, link_class="ici", ops=("psum",)))
+        res = model.refit(min_measurements=8)
+        assert res["refitted"]
+        # everything doubled => worst |t_new/t_old - 1| == 1.0
+        assert res["drift"]["max_drift"] == pytest.approx(1.0, rel=1e-3)
+        assert ("psum|f32|ici" in res["drift"]["curves"])
+        new = res["model"]
+        assert new.predict("psum", 1 << 16, 4, link_class="ici") \
+            == pytest.approx(
+                2 * model.predict("psum", 1 << 16, 4, link_class="ici"),
+                rel=1e-3)
+
+    def test_unremeasured_curves_merge_and_self_unmutated(self):
+        model = self._base()
+        old_dcn = model.predict("psum", 1 << 16, 4, link_class="dcn")
+        old_ici = model.predict("psum", 1 << 16, 4, link_class="ici")
+        model.update(simulate_link_measurements(
+            4e-6, 4e-9, link_class="ici", ops=("psum",)))
+        new = model.refit(min_measurements=8)["model"]
+        # only ici was re-measured; the dcn tier keeps the old fit
+        assert new.predict("psum", 1 << 16, 4, link_class="dcn") \
+            == old_dcn
+        assert new.predict("psum", 1 << 16, 4, link_class="ici") \
+            == pytest.approx(4 * old_ici, rel=1e-3)
+        # the caller owns adoption: self never moved
+        assert model.predict("psum", 1 << 16, 4, link_class="ici") \
+            == old_ici
+
+    def test_refit_stamps_staleness_metadata(self):
+        model = self._base()
+        model.update(simulate_link_measurements(
+            2e-6, 2e-9, link_class="ici", ops=("psum",)))
+        n_fresh = len(model.fresh_measurements)
+        new = model.refit(min_measurements=8, now=777.0)["model"]
+        assert new.meta["probed_at"] == 777.0
+        assert new.meta["n_measurements"] == n_fresh
+        assert not new.is_stale(10.0, now=780.0)
+
+    def test_refit_within_validate_on_holdout(self):
+        model = self._base()
+        pts = simulate_link_measurements(
+            3e-6, 3e-9, link_class="ici", ops=("psum",),
+            sizes=(1 << 12, 1 << 14, 1 << 16, 1 << 18, 1 << 20))
+        train, held = holdout_split(pts, every=3)
+        assert held
+        model.update(train)
+        new = model.refit(min_measurements=8)["model"]
+        report = new.validate(held, tolerance=2.0)
+        assert report["within_tolerance"], report

@@ -108,8 +108,13 @@ def test_plan_single_pod_dict_stays_pre_mpmd():
 # ---------------------------------------------------------------------------
 
 
-def test_dcn_fault_is_last_kind():
-    assert FAULT_KINDS[-1] == "dcn_fault"
+def test_dcn_fault_precedes_later_appended_kinds():
+    # dcn_fault was appended last in its PR; later kinds (cost_drift,
+    # plan_regression) append AFTER it, never before — rate-0 kinds
+    # consume no rng, so the relative order is what keeps every
+    # pre-existing from_seed schedule byte-identical.
+    assert FAULT_KINDS.index("dcn_fault") == len(FAULT_KINDS) - 3
+    assert FAULT_KINDS[-2:] == ("cost_drift", "plan_regression")
 
 
 def test_dcn_fault_rate0_consumes_no_rng():

@@ -34,6 +34,16 @@ Usage:
     python tools/autotune.py --devices 8 --profile comms_profile.json \\
         --hbm-gb 0.5 --top-k 3 --out plan.json
 
+``--rank-only`` stops after gate 2 (:func:`rank_plans`): enumerate,
+prune and rank against the profile without measuring — the shadow
+re-rank the parallelism autopilot
+(:class:`apex_tpu.resilience.autopilot.ParallelismAutopilot`) runs in
+the background when a REFRESHED profile drifts, leaving the live
+measurement to its own K-step commit gate:
+
+    python tools/autotune.py --devices 8 --rank-only \\
+        --profile refreshed_profile.json --out reranked_plan.json
+
 ``--mpmd`` switches to the two-tier cross-pod planner: enumerate
 ``(pp, per-stage dp x tp, M)`` plans for ``--pods`` pod blocks, price
 each under both MPMD schedules with the
@@ -592,37 +602,16 @@ def autotune_mpmd(n_devices: int, *, cfg_kw: Optional[dict] = None,
 # -- the planner --------------------------------------------------------------
 
 
-def autotune(n_devices: int, *, cfg_kw: Optional[dict] = None,
-             batch: int = 8, seq: Optional[int] = None,
-             hbm_bytes: float = 0.5 * (1 << 30), cost_model=None,
-             top_k: int = 3, max_tp: Optional[int] = None,
-             max_pp: Optional[int] = None, zero: bool = True,
-             remat_options: Sequence[bool] = (False, True),
-             devices=None, measure_iters: int = 2,
-             measure_rounds: int = 2,
-             verbose: bool = True) -> dict:
-    """Full prune -> rank -> measure pass; returns the report dict
-    (the same structure :func:`emit_plan` writes)."""
+def _rank(n_devices, *, cfg_kw, batch, seq, hbm_bytes, cost_model,
+          max_tp, max_pp, zero, remat_options, devices, say):
+    """Shared enumerate -> compile -> memory-prune -> cost-rank pass.
+    Returns ``(cands, ranked, flops_per_s, compiled_by_id)`` — the
+    ranked survivors best-first plus the compiled programs keyed by
+    candidate identity, so :func:`autotune` can measure the top K
+    without recompiling."""
     import jax
 
     from apex_tpu.analysis.memory import estimate_peak_memory
-    from tools._timing import time_steps
-
-    def say(msg):
-        if verbose:
-            print(msg, flush=True)
-
-    cfg_kw = dict(cfg_kw or DEFAULT_MODEL)
-    _reject_weight_quant(cfg_kw)
-    seq = seq if seq is not None else cfg_kw["max_seq_len"]
-    devices = (list(devices) if devices is not None
-               else jax.devices()[:n_devices])
-    if len(devices) < n_devices:
-        raise RuntimeError(f"need {n_devices} devices, have "
-                           f"{len(devices)}")
-    if cost_model is None:
-        say("no comms profile given; probing a minimal one in-process")
-        cost_model = _default_cost_model(n_devices)
 
     cands = enumerate_space(
         n_devices, n_layers=cfg_kw["num_layers"],
@@ -677,6 +666,102 @@ def autotune(n_devices: int, *, cfg_kw: Optional[dict] = None,
     if not ranked:
         raise RuntimeError("no candidate fits the HBM budget; raise "
                            "--hbm-gb or shrink the model")
+    return cands, ranked, flops_per_s, compiled_by_id
+
+
+def rank_plans(n_devices: int, *, cfg_kw: Optional[dict] = None,
+               batch: int = 8, seq: Optional[int] = None,
+               hbm_bytes: float = 0.5 * (1 << 30), cost_model=None,
+               max_tp: Optional[int] = None,
+               max_pp: Optional[int] = None, zero: bool = True,
+               remat_options: Sequence[bool] = (False, True),
+               devices=None, verbose: bool = True) -> dict:
+    """Rank-only pass: enumerate -> compile -> prune -> rank against
+    the given CostModel WITHOUT the measure phase — the background
+    re-rank entry point the parallelism autopilot
+    (:class:`apex_tpu.resilience.autopilot.ParallelismAutopilot`) runs
+    against a REFRESHED profile: ranking costs compiles, not training
+    steps, so it can shadow a live job; the winner is then proven by
+    the autopilot's own K-step commit gate instead of an offline
+    measurement.  Returns the same report shape as :func:`autotune`
+    with ``mode="rank"`` and no ``measured_s``."""
+    import jax
+
+    def say(msg):
+        if verbose:
+            print(msg, flush=True)
+
+    cfg_kw = dict(cfg_kw or DEFAULT_MODEL)
+    _reject_weight_quant(cfg_kw)
+    seq = seq if seq is not None else cfg_kw["max_seq_len"]
+    devices = (list(devices) if devices is not None
+               else jax.devices()[:n_devices])
+    if len(devices) < n_devices:
+        raise RuntimeError(f"need {n_devices} devices, have "
+                           f"{len(devices)}")
+    if cost_model is None:
+        say("no comms profile given; probing a minimal one in-process")
+        cost_model = _default_cost_model(n_devices)
+
+    cands, ranked, flops_per_s, _ = _rank(
+        n_devices, cfg_kw=cfg_kw, batch=batch, seq=seq,
+        hbm_bytes=hbm_bytes, cost_model=cost_model, max_tp=max_tp,
+        max_pp=max_pp, zero=zero, remat_options=remat_options,
+        devices=devices, say=say)
+    winner = ranked[0]
+    say(f"winner (ranked, unmeasured): {winner.plan.describe()} "
+        f"({winner.predicted_s * 1e3:.3f} ms/step predicted)")
+    return {
+        "version": AUTOTUNE_VERSION,
+        "mode": "rank",
+        "n_devices": n_devices,
+        "model": cfg_kw,
+        "batch": batch,
+        "seq": seq,
+        "hbm_bytes": int(hbm_bytes),
+        "flops_per_s": flops_per_s,
+        "plan": winner.plan.to_dict(),
+        "predicted_s": winner.predicted_s,
+        "candidates": [c.to_dict() for c in cands],
+    }
+
+
+def autotune(n_devices: int, *, cfg_kw: Optional[dict] = None,
+             batch: int = 8, seq: Optional[int] = None,
+             hbm_bytes: float = 0.5 * (1 << 30), cost_model=None,
+             top_k: int = 3, max_tp: Optional[int] = None,
+             max_pp: Optional[int] = None, zero: bool = True,
+             remat_options: Sequence[bool] = (False, True),
+             devices=None, measure_iters: int = 2,
+             measure_rounds: int = 2,
+             verbose: bool = True) -> dict:
+    """Full prune -> rank -> measure pass; returns the report dict
+    (the same structure :func:`emit_plan` writes)."""
+    import jax
+
+    from tools._timing import time_steps
+
+    def say(msg):
+        if verbose:
+            print(msg, flush=True)
+
+    cfg_kw = dict(cfg_kw or DEFAULT_MODEL)
+    _reject_weight_quant(cfg_kw)
+    seq = seq if seq is not None else cfg_kw["max_seq_len"]
+    devices = (list(devices) if devices is not None
+               else jax.devices()[:n_devices])
+    if len(devices) < n_devices:
+        raise RuntimeError(f"need {n_devices} devices, have "
+                           f"{len(devices)}")
+    if cost_model is None:
+        say("no comms profile given; probing a minimal one in-process")
+        cost_model = _default_cost_model(n_devices)
+
+    cands, ranked, flops_per_s, compiled_by_id = _rank(
+        n_devices, cfg_kw=cfg_kw, batch=batch, seq=seq,
+        hbm_bytes=hbm_bytes, cost_model=cost_model, max_tp=max_tp,
+        max_pp=max_pp, zero=zero, remat_options=remat_options,
+        devices=devices, say=say)
 
     for c in ranked[:top_k]:
         compiled, args = compiled_by_id[id(c)]
@@ -749,6 +834,12 @@ def main(argv=None):
                     help="per-device HBM budget for the memory prune")
     ap.add_argument("--top-k", type=int, default=3,
                     help="ranked candidates to measure for real")
+    ap.add_argument("--rank-only", action="store_true",
+                    help="skip the measure phase: enumerate, prune and "
+                         "rank against the profile only — the shadow "
+                         "re-rank the parallelism autopilot runs on a "
+                         "refreshed profile (the commit gate measures "
+                         "the winner live instead)")
     ap.add_argument("--batch", type=int, default=8,
                     help="global batch rows for the probe workload")
     ap.add_argument("--max-tp", type=int, default=None)
@@ -795,6 +886,13 @@ def main(argv=None):
         report = autotune_mpmd(
             n, batch=args.batch, n_pods=args.pods,
             cost_model=cost_model, dcn=dcn, max_tp=args.max_tp,
+            verbose=not args.quiet)
+    elif args.rank_only:
+        report = rank_plans(
+            n, hbm_bytes=args.hbm_gb * (1 << 30), cost_model=cost_model,
+            batch=args.batch, max_tp=args.max_tp,
+            max_pp=args.max_pp, zero=not args.no_zero,
+            remat_options=(False,) if args.no_remat else (False, True),
             verbose=not args.quiet)
     else:
         report = autotune(

@@ -70,6 +70,11 @@ def main(argv=None):
     ap.add_argument("--check", metavar="PROFILE", default=None,
                     help="skip probing; re-validate PROFILE against "
                          "its stored measurements")
+    ap.add_argument("--max-age-s", type=float, default=None,
+                    help="with --check: additionally gate on profile "
+                         "staleness — fail when the probe stamp is "
+                         "older than this many seconds or missing "
+                         "entirely (never probed)")
     ap.add_argument("--link-class", default="ici",
                     help="fabric tag for the probed measurements "
                          "(ici | dcn; default ici)")
@@ -110,8 +115,25 @@ def main(argv=None):
                   "re-validate", file=sys.stderr)
             return 2
         report = model.validate(ms, tolerance=args.tolerance)
-        print(json.dumps({k: v for k, v in report.items()
-                          if k != "rows"}, indent=1))
+        out = {k: v for k, v in report.items() if k != "rows"}
+        # staleness is orthogonal to fit quality: a profile can still
+        # predict its OWN stored measurements perfectly while being a
+        # year out of date (drifted), or carry no stamp at all (never
+        # probed on this fleet) — surface both so the autopilot's
+        # max_profile_age_s gate has the same data offline
+        age = model.profile_age()
+        out["profile_age_s"] = age
+        out["n_measurements"] = model.meta.get("n_measurements")
+        if args.max_age_s is not None:
+            out["stale"] = model.is_stale(args.max_age_s)
+            out["max_age_s"] = args.max_age_s
+        print(json.dumps(out, indent=1))
+        if args.max_age_s is not None and out["stale"]:
+            reason = ("no probe stamp (never probed)" if age is None
+                      else f"probed {age:.0f}s ago")
+            print(f"profile is stale: {reason} (gate "
+                  f"{args.max_age_s:.0f}s)", file=sys.stderr)
+            return 1
         return 0 if report["within_tolerance"] else 1
 
     from apex_tpu.observability.costmodel import COLLECTIVE_OPS

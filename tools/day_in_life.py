@@ -39,6 +39,20 @@ or through the loadgen scenario suite (set
 ``XLA_FLAGS=--xla_force_host_platform_device_count=4`` first)::
 
     python tools/loadgen.py --scenario capacity_diurnal
+
+``--autopilot`` runs the self-driving-parallelism day instead
+(= loadgen ``--scenario autopilot_drift``): same fleet + diurnal
+traffic, but the capacity controller is replaced by a
+:class:`~apex_tpu.resilience.autopilot.ParallelismAutopilot` and the
+chaos is a mid-day interconnect drift — links go 16x slower (the
+autopilot must DETECT it from refitted telemetry and commit dp 4 -> 2
+through the measured gate), then recover with an injected
+``plan_regression`` poisoning the re-adoption's commit gate (forced
+measured rollback).  Gates: exactly-once delivery, SLO attainment
+>= 0.9, >= 1 commit AND >= 1 rollback, adoption counters matching the
+applied-fault log, a flap-free :meth:`ParallelismAutopilot.audit`, and
+the finished training state bitwise vs an uninterrupted fixed-plan
+reference.
 """
 
 from __future__ import annotations
@@ -409,20 +423,321 @@ def print_report(report: dict) -> None:
     print(f"day_in_life {'OK: all gates pass' if ok else 'FAILED'}")
 
 
+# -- the autopilot day (ROADMAP item 3: self-driving parallelism) ------------
+
+
+def autopilot_args(seed: int = 0, requests: int = 240,
+                   json_out: bool = False,
+                   **overrides) -> argparse.Namespace:
+    """Knobs for the ``autopilot_drift`` day: the capacity day's fleet
+    + workload shape, with the capacity controller replaced by a
+    :class:`~apex_tpu.resilience.autopilot.ParallelismAutopilot` and a
+    mid-day interconnect drift schedule."""
+    ns = day_args(seed=seed, requests=requests, json_out=json_out)
+    ns.scenario = "autopilot_drift"
+    # the simulated interconnect: dcn-class alpha-beta coefficients
+    # shared by the autopilot's loaded profile and the driver's
+    # synthetic step-time model, so detection is honest (refit-driven)
+    ns.link_alpha = 2e-3
+    ns.link_beta = 1e-9
+    ns.serial_s = 0.12
+    # drift schedule, in TRAINER steps: links drift_scale x slower
+    # mid-morning (=> commit dp 4 -> 2), recover mid-afternoon with an
+    # injected plan_regression poisoning the re-adoption's commit gate
+    # (=> measured rollback to dp 2)
+    ns.drift_step = 6
+    ns.recover_step = 22
+    ns.drift_scale = 16.0
+    ns.regression_scale = 4.0
+    # autopilot knobs (cooldown on the VIRTUAL clock)
+    ns.drift_threshold = 0.3
+    ns.confirm_windows = 2
+    ns.min_measurements = 8
+    ns.adopt_cooldown_s = 0.5
+    ns.gate_steps = 2
+    ns.gate_tolerance = 1.2
+    for k, v in overrides.items():
+        setattr(ns, k, v)
+    return ns
+
+
+_GRAD_BYTES = 8 * 4 * 4 + 4 * 4   # _factory's params: w (8x4 f32) + b
+
+
+def _drift_dt(step: int, dp: int, args) -> float:
+    """Synthetic measured step time under the drift schedule: perfectly
+    dp-scalable serial compute + the alpha-beta price of the gradient
+    all-reduce at the CURRENTLY drifted link coefficients."""
+    from apex_tpu.observability.costmodel import CostFit
+
+    scale = 1.0
+    if step >= args.drift_step:
+        scale *= args.drift_scale
+    if step >= args.recover_step:
+        scale /= args.drift_scale
+    fit = CostFit(args.link_alpha * scale, args.link_beta * scale)
+    comm = fit.predict("psum", _GRAD_BYTES, dp) if dp > 1 else 0.0
+    return args.serial_s / dp + comm
+
+
+def run_autopilot_day(args) -> dict:
+    from apex_tpu.observability import (FlightRecorder, MetricsRegistry,
+                                        Tracer)
+    from apex_tpu.observability.costmodel import (
+        fit_cost_model, simulate_link_measurements)
+    from apex_tpu.observability.slo import SLOMonitor, SLOTarget
+    from apex_tpu.resilience import (ElasticPlan, ElasticTrainer, Fault,
+                                     FaultInjector, ParallelismAutopilot,
+                                     TopologySpec)
+    from apex_tpu.serving import (FleetRouter, PagedInferenceEngine,
+                                  RequestShed, TickScheduler, VirtualClock)
+    from apex_tpu.utils.profiling import ServingMetrics
+
+    if jax.device_count() < args.base_dp:
+        return {"skipped": f"needs >= {args.base_dp} devices "
+                           f"(have {jax.device_count()}); set XLA_FLAGS="
+                           "--xla_force_host_platform_device_count=4",
+                "gates": {}}
+
+    clock = VirtualClock()
+    recorder = FlightRecorder(clock=clock)
+    registry = MetricsRegistry()
+    devices = jax.devices()[:args.base_dp]
+
+    model, params = loadgen._build_model(args)
+    replicas = loadgen._build_replicas(args, model, params, clock)
+    fleet = FleetRouter(
+        replicas, clock=clock,
+        max_queue_depth=args.max_queue_depth,
+        burn_threshold=args.burn_threshold,
+        burn_window_s=args.burn_window_s,
+        retry_budget=args.retry_budget,
+        hedge_after_s=args.hedge_after_s,
+        seed=args.seed, tracer=Tracer(clock=clock, id_tag="router"),
+        recorder=recorder)
+
+    profile = fit_cost_model(
+        simulate_link_measurements(args.link_alpha, args.link_beta,
+                                   link_class="dcn", ops=("psum",))
+        + simulate_link_measurements(1e-6, 1e-10, link_class="ici",
+                                     ops=("psum",)),
+        meta={"source": "autopilot_day"})
+    inj = FaultInjector([
+        Fault(args.drift_step, "cost_drift",
+              magnitude=args.drift_scale),
+        Fault(args.recover_step, "cost_drift",
+              magnitude=1.0 / args.drift_scale),
+        Fault(args.recover_step, "plan_regression",
+              magnitude=args.regression_scale)])
+
+    root = tempfile.mkdtemp(prefix="apex_tpu_autopilot_day_")
+    try:
+        base = TopologySpec(dp=args.base_dp)
+        trainer = ElasticTrainer(
+            _factory, ElasticPlan.build(base, devices=devices),
+            directory=root + "/day", fault_injector=inj,
+            save_every=1, devices=devices, recorder=recorder)
+        autopilot = ParallelismAutopilot(
+            trainer, profile, min_dp=args.min_train_dp,
+            link_class="dcn", drift_threshold=args.drift_threshold,
+            confirm_windows=args.confirm_windows,
+            min_measurements=args.min_measurements,
+            cooldown_s=args.adopt_cooldown_s,
+            gate_steps=args.gate_steps,
+            gate_tolerance=args.gate_tolerance,
+            injector=inj, registry=registry, recorder=recorder,
+            tracer=Tracer(clock=clock, id_tag="autopilot"),
+            clock=clock)
+
+        work = loadgen.synthesize_scenario(args)
+        crng = np.random.RandomState(args.seed + 1)
+        pending = [(t, i, req, int(args.client_retries))
+                   for i, (t, req) in enumerate(work)]
+        seq = len(pending)
+        submit_t: dict = {}
+        finish_t: dict = {}
+        submitted: set = set()
+        shed_client: dict = {}
+        ticks = seen = 0
+        while True:
+            now = clock()
+            while pending and pending[0][0] <= now:
+                _, _, req, retries = pending.pop(0)
+                try:
+                    fleet.submit(req)
+                    submitted.add(req.request_id)
+                    submit_t.setdefault(req.request_id, now)
+                    shed_client.pop(req.request_id, None)
+                except RequestShed as e:
+                    if retries > 0:
+                        back = e.retry_after_s * (1.0 + 0.5 * crng.rand())
+                        bisect.insort(
+                            pending, (now + back, seq, req, retries - 1))
+                        seq += 1
+                    else:
+                        shed_client[req.request_id] = e.reason.value
+            busy = fleet.step()
+            if ticks % args.train_every == 0 \
+                    and trainer.current_step < args.train_steps:
+                step = trainer.current_step
+                trainer.step_once(_batch_fn)
+                autopilot.record_step(
+                    _drift_dt(step, trainer.plan.spec.dp, args))
+                autopilot.tick()
+                autopilot.tick()
+            clock.advance(args.tick_s)
+            ticks += 1
+            done = fleet.completed
+            while seen < len(done):
+                finish_t[done[seen].request_id] = clock()
+                seen += 1
+            if not pending and not busy \
+                    and trainer.current_step >= args.train_steps \
+                    and not autopilot.adopting \
+                    and not any(e is not None and (e._queue or e._active)
+                                for e in fleet.replicas):
+                break
+            if ticks >= args.max_ticks:
+                break
+
+        responses = {r.request_id: r for r in fleet.completed}
+        dup = len(fleet.completed) - len(responses)
+        lost = sorted(submitted - set(responses))
+        e2e_ok = [finish_t[rid] - submit_t[rid]
+                  for rid, rep in responses.items()
+                  if rep.finish_reason in ("eos", "length")
+                  and rid in finish_t and rid in submit_t]
+        attainment = (sum(1 for v in e2e_ok if v <= args.e2e_slo_s)
+                      / len(e2e_ok)) if e2e_ok else 0.0
+
+        # the full cycle must leave training bit-identical to a run
+        # that never drifted: same batches, fixed plan, no autopilot
+        ref = ElasticTrainer(
+            _factory, ElasticPlan.build(base, devices=devices),
+            directory=root + "/ref", save_every=1, devices=devices)
+        ref.train(_batch_fn, args.train_steps)
+        bitwise = (trainer.current_step >= args.train_steps
+                   and _bitwise_ok(_flat(trainer), _flat(ref)))
+
+        audit = autopilot.audit()
+        drifts = sum(1 for _, k in inj.log if k == "cost_drift")
+        regressions = sum(1 for _, k in inj.log
+                          if k == "plan_regression")
+        commits = registry.get("autopilot_adoptions_total").value(
+            outcome="commit")
+        rollbacks = registry.get("autopilot_adoptions_total").value(
+            outcome="rollback")
+        gates = {
+            "exactly_once_lost": lost == [],
+            "exactly_once_dup": dup == 0,
+            "slo_attainment": attainment >= 0.9,
+            "train_completed":
+                trainer.current_step >= args.train_steps,
+            "train_bitwise": bitwise,
+            "adoption_committed": autopilot.stats["adoptions"] >= 1,
+            "regression_rolled_back":
+                autopilot.stats["rollbacks"] >= 1,
+            "no_out_of_band_flaps": audit == [],
+            "counters_match_faults":
+                commits + rollbacks == drifts
+                and rollbacks == regressions
+                and autopilot.queued == 0,
+        }
+        return {
+            "scenario": "autopilot_drift",
+            "requests": args.requests,
+            "submitted": len(submitted),
+            "responses": len(responses),
+            "lost": lost,
+            "duplicated": dup,
+            "shed_client": len(shed_client),
+            "outcomes": loadgen._outcome_counts(responses,
+                                                len(shed_client)),
+            "ticks": ticks,
+            "virtual_s": clock(),
+            "e2e_served": len(e2e_ok),
+            "e2e_p50_s": loadgen._pct(e2e_ok, 50),
+            "e2e_p99_s": loadgen._pct(e2e_ok, 99),
+            "slo_attainment": attainment,
+            "migrations": fleet.migrations,
+            "train": {
+                "steps": trainer.current_step,
+                "final_dp": trainer.plan.spec.dp,
+            },
+            "autopilot": {
+                "refits": autopilot.stats["refits"],
+                "drift_confirmed": autopilot.stats["drift_confirmed"],
+                "adoptions": autopilot.stats["adoptions"],
+                "rollbacks": autopilot.stats["rollbacks"],
+                "no_change": autopilot.stats["no_change"],
+                "last_drift": autopilot.stats["last_drift"],
+                "last_adoption": autopilot.stats["last_adoption"],
+                "adoption_log": autopilot.adoption_log,
+                "audit": audit,
+                "fault_log": list(inj.log),
+            },
+            "flight_snapshots": len(recorder.dumps),
+            "gates": gates,
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def print_autopilot_report(report: dict) -> None:
+    if report.get("skipped"):
+        print(f"autopilot_day SKIPPED: {report['skipped']}")
+        return
+    ap = report["autopilot"]
+    print(f"autopilot_day: {report['responses']}/{report['submitted']} "
+          f"answered (lost {len(report['lost'])}, "
+          f"dup {report['duplicated']}, "
+          f"client-shed {report['shed_client']}) over "
+          f"{report['ticks']} ticks / {report['virtual_s']:.1f}s virtual")
+    print(f"  outcomes {report['outcomes']}")
+    print(f"  slo attainment {report['slo_attainment']:.0%} "
+          f"(e2e p50 {report['e2e_p50_s'] * 1e3:.0f} ms, "
+          f"p99 {report['e2e_p99_s'] * 1e3:.0f} ms)")
+    print(f"  train: {report['train']['steps']} steps, "
+          f"final dp={report['train']['final_dp']}")
+    print(f"  autopilot: {ap['refits']} refit windows, "
+          f"{ap['drift_confirmed']} drift confirmation(s), "
+          f"{ap['adoptions']} commit(s), {ap['rollbacks']} rollback(s)")
+    for e in ap["adoption_log"]:
+        print(f"    tick {e['tick']:5d} {e['old']} -> {e['new']}: "
+              f"{e['outcome']}"
+              + (f" ({e['reason']})" if e["reason"] else ""))
+    print(f"  faults applied: {ap['fault_log']}")
+    print(f"  {report['flight_snapshots']} flight snapshot(s)")
+    ok = all(report["gates"].values())
+    for name, passed in report["gates"].items():
+        print(f"  gate {name:<22} {'PASS' if passed else 'FAIL'}")
+    print(f"autopilot_day {'OK: all gates pass' if ok else 'FAILED'}")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--requests", type=int, default=140)
     ap.add_argument("--train-steps", type=int, default=40)
     ap.add_argument("--max-ticks", type=int, default=4000)
+    ap.add_argument("--autopilot", action="store_true",
+                    help="run the autopilot_drift day (self-driving "
+                         "parallelism) instead of the capacity day")
     ap.add_argument("--json", action="store_true")
     a = ap.parse_args(argv)
-    report = run_day(day_args(seed=a.seed, requests=a.requests,
-                              json_out=a.json,
-                              train_steps=a.train_steps,
-                              max_ticks=a.max_ticks))
+    if a.autopilot:
+        report = run_autopilot_day(autopilot_args(
+            seed=a.seed, requests=a.requests, json_out=a.json,
+            train_steps=a.train_steps, max_ticks=a.max_ticks))
+    else:
+        report = run_day(day_args(seed=a.seed, requests=a.requests,
+                                  json_out=a.json,
+                                  train_steps=a.train_steps,
+                                  max_ticks=a.max_ticks))
     if a.json:
         print(json.dumps(report, indent=2))
+    elif a.autopilot:
+        print_autopilot_report(report)
     else:
         print_report(report)
     return 0 if report["gates"] and all(report["gates"].values()) else 1

@@ -57,6 +57,17 @@ SLO burn are deterministic on any host:
   burn-driven :class:`~apex_tpu.resilience.capacity.CapacityController`
   (delegates to ``tools/day_in_life.py``, which owns the training side
   and the hard gates);
+* ``autopilot_drift`` — the self-driving-parallelism day (ROADMAP
+  item 3): diurnal traffic beside a live trainer whose
+  :class:`~apex_tpu.resilience.autopilot.ParallelismAutopilot` must
+  DETECT a mid-day interconnect drift from refitted telemetry, commit
+  a re-ranked plan through the measured drain→gate protocol, then ROLL
+  BACK a second adoption whose commit gate an injected
+  ``plan_regression`` poisons; GATES on exactly-once delivery, SLO
+  attainment ≥ 0.9, ≥ 1 commit AND ≥ 1 rollback with counters matching
+  the applied-fault log, a flap-free audit, and training state bitwise
+  vs an uninterrupted fixed-plan reference (delegates to
+  ``tools/day_in_life.py --autopilot``);
 * ``disagg_diurnal`` — a mixed day against a
   :class:`~apex_tpu.serving.DisaggregatedFleet`: a prefill-heavy
   morning (long prompts, short generations) flips mid-day into a
@@ -106,8 +117,8 @@ import jax            # noqa: E402
 import numpy as np    # noqa: E402
 
 SCENARIOS = ("steady", "replica_kill", "slow_replica", "diurnal", "bursty",
-             "capacity_diurnal", "disagg_diurnal", "disagg_longctx_fair",
-             "disagg_quant")
+             "capacity_diurnal", "autopilot_drift", "disagg_diurnal",
+             "disagg_longctx_fair", "disagg_quant")
 
 DISAGG_SCENARIOS = ("disagg_diurnal", "disagg_longctx_fair",
                     "disagg_quant")
@@ -315,7 +326,8 @@ def synthesize_scenario(args):
         while len(times) < n:
             times.extend([t] * min(args.burst_n, n - len(times)))
             t += args.burst_gap_s
-    elif args.scenario in ("diurnal", "capacity_diurnal"):
+    elif args.scenario in ("diurnal", "capacity_diurnal",
+                           "autopilot_drift"):
         # thinning: candidate arrivals at the peak rate, accepted with
         # probability rate(t)/peak where rate(t) ~ sin^2 over --period-s
         t = 0.0
@@ -821,6 +833,18 @@ def main(argv=None) -> int:
             print(json.dumps(report, indent=2))
         else:
             day_in_life.print_report(report)
+        return 0 if all(report["gates"].values()) else 1
+
+    if args.scenario == "autopilot_drift":
+        # ditto: the autopilot sim owns a training side — delegate to
+        # the day-in-the-life driver's autopilot day
+        import day_in_life
+        report = day_in_life.run_autopilot_day(day_in_life.autopilot_args(
+            seed=args.seed, requests=args.requests, json_out=args.json))
+        if args.json:
+            print(json.dumps(report, indent=2))
+        else:
+            day_in_life.print_autopilot_report(report)
         return 0 if all(report["gates"].values()) else 1
 
     if args.scenario in DISAGG_SCENARIOS:
