@@ -28,15 +28,22 @@ Integration: :meth:`save_checkpoint` writes per-stage
 one stamped ``MPMD_PLAN.json`` (restore validates the cross-pod plan
 and :meth:`restore_stage` re-seats a single killed stage);
 ``trace=True`` gives every stage a
-:class:`~apex_tpu.observability.spans.Tracer` lane and threads
+:class:`~apex_tpu.observability.spans.Tracer` lane, threads
 per-microbatch flow events (``dcn_send``/``dcn_recv``) through every
-cross-pod hop — :meth:`collector` returns the
+cross-pod hop, and records the structured per-op anatomy events
+(``mpmd_op`` compute spans, ``mpmd_xfer`` link spans with their link
+class, one ``mpmd_schedule`` marker per step) that
+:mod:`apex_tpu.observability.anatomy` reconstructs into a measured
+timeline — :meth:`anatomy_events` hands them over, and
+``measure_ops=True`` additionally blocks on each op so the spans
+measure device time, not dispatch.  :meth:`collector` returns the
 :class:`~apex_tpu.observability.fleetobs.FleetCollector` whose
 ``continuity()`` must come back unbroken.
 """
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 from typing import Any, Dict, List, Optional
@@ -64,7 +71,7 @@ class MpmdPipeline:
     def __init__(self, model_kw: Dict[str, Any], params, plan, *,
                  devices=None, lr: float = 1e-3, channel=None,
                  fault_injector=None, schedule: str = "1f1b",
-                 trace: bool = False):
+                 trace: bool = False, measure_ops: bool = False):
         import jax
 
         from apex_tpu.parallel.plan import ParallelPlan
@@ -143,8 +150,13 @@ class MpmdPipeline:
                 lr=lr))
             cursor += need
 
+        # measure_ops implies trace: each op's result is blocked on
+        # inside its span, so span durations are honest device times —
+        # at the cost of serializing dispatch (an anatomy/profiling
+        # mode, not the production fast path)
+        self.measure_ops = bool(measure_ops)
         self.tracers = None
-        if trace:
+        if trace or self.measure_ops:
             from apex_tpu.observability.spans import Tracer
             self.tracers = [Tracer(id_tag=f"stage{i}")
                             for i in range(self.n_stages)]
@@ -158,15 +170,44 @@ class MpmdPipeline:
         # the tied-embedding sync between the first and last pod
         return "dcn" if self.plan.n_pods > 1 else "ici"
 
+    def _block(self, tree):
+        """Wait for every leaf (anatomy mode): span durations then
+        measure the work, not just its dispatch."""
+        if self.measure_ops:
+            import jax
+            for leaf in jax.tree_util.tree_leaves(tree):
+                blocker = getattr(leaf, "block_until_ready", None)
+                if blocker is not None:
+                    blocker()
+        return tree
+
+    def _op_span(self, s: int, kind: str, m: int, step: int, **extra):
+        """The per-op structured trace span anatomy reconstructs from
+        (no-op without tracing)."""
+        if self.tracers is None:
+            return contextlib.nullcontext()
+        return self.tracers[s].span(
+            "mpmd_op", device=False, op=kind, stage=s, mb=m,
+            step=step, **extra)
+
     def _transfer(self, src: int, dst: int, value, dst_shardings, *,
                   step: int, ctx=None, phase: str = "act"):
         from apex_tpu.observability.fleetobs import emit_flow
         edge = Edge(src, dst, self._link_class(src, dst))
+        # phase is "fwd.m3" / "bwd.m5" for schedule edges and
+        # "head_grad" / "embed_total" for the tied-embedding sync
+        kind, _, mbs = phase.partition(".m")
+        cm = contextlib.nullcontext()
         if self.tracers is not None:
             emit_flow(self.tracers[src], ctx, "dcn_send",
                       edge=f"{src}->{dst}", payload=phase)
-        out = self.channel.send_with_retry(value, dst_shardings,
-                                           step=step, edge=edge)
+            cm = self.tracers[src].span(
+                "mpmd_xfer", device=False, src=src, dst=dst,
+                kind=kind, mb=int(mbs) if mbs else -1,
+                link_class=edge.link_class, step=step)
+        with cm:
+            out = self._block(self.channel.send_with_retry(
+                value, dst_shardings, step=step, edge=edge))
         if self.tracers is not None:
             emit_flow(self.tracers[dst], ctx, "dcn_recv",
                       edge=f"{src}->{dst}", payload=phase)
@@ -251,6 +292,12 @@ class MpmdPipeline:
         if self.tracers is not None:
             ctxs = {m: TraceContext.mint(f"s{step}.m{m}")
                     for m in range(M)}
+            self.tracers[0].instant(
+                "mpmd_schedule", n_stages=S, n_microbatches=M,
+                schedule=self.schedule_name, step=step, dp=self.dp,
+                link_classes={str(e): c for e, c
+                              in self._edge_class.items()},
+                measured=self.measure_ops)
         stash_x: Dict[Any, Any] = {}
         stash_dy: Dict[Any, Any] = {}
 
@@ -263,28 +310,36 @@ class MpmdPipeline:
                 # interior stages keep their input in the stash: the
                 # backward recomputes the stage forward from it
                 x = x_all if st.is_first else stash_x[(s, m)]
-                y = st.run_fwd(x, m)
+                with self._op_span(s, "fwd", m, step):
+                    y = self._block(st.run_fwd(x, m))
                 nxt = self.stages[s + 1]
                 stash_x[(s + 1, m)] = self._transfer(
                     s, s + 1, y, nxt.act_sharding, step=step, ctx=ctx,
                     phase=f"fwd.m{m}")
             else:
                 if st.is_last:
-                    accs[s], lacc, loss_acc, dx = st.run_bwd_last(
-                        targets_d, stash_x.pop((s, m)), accs[s], lacc,
-                        loss_acc, m)
+                    with self._op_span(s, "bwd", m, step,
+                                       folded_fwd=True):
+                        accs[s], lacc, loss_acc, dx = st.run_bwd_last(
+                            targets_d, stash_x.pop((s, m)), accs[s],
+                            lacc, loss_acc, m)
+                        self._block(dx)
                 elif st.is_first:
-                    accs[s], dx0 = st.run_bwd(
-                        x_all, stash_dy.pop((s, m)), accs[s], m,
-                        dx0=dx0)
+                    with self._op_span(s, "bwd", m, step):
+                        accs[s], dx0 = st.run_bwd(
+                            x_all, stash_dy.pop((s, m)), accs[s], m,
+                            dx0=dx0)
+                        self._block(dx0)
                     if self.tracers is not None:
                         emit_flow(self.tracers[0], ctx, "mb_done",
                                   final=True)
                     continue
                 else:
-                    accs[s], dx = st.run_bwd(
-                        stash_x.pop((s, m)), stash_dy.pop((s, m)),
-                        accs[s], m)
+                    with self._op_span(s, "bwd", m, step):
+                        accs[s], dx = st.run_bwd(
+                            stash_x.pop((s, m)), stash_dy.pop((s, m)),
+                            accs[s], m)
+                        self._block(dx)
                 prv = self.stages[s - 1]
                 stash_dy[(s - 1, m)] = self._transfer(
                     s, s - 1, dx, prv.act_sharding, step=step, ctx=ctx,
@@ -416,3 +471,18 @@ class MpmdPipeline:
         for i, tr in enumerate(self.tracers):
             c.add_replica(f"stage{i}", tracer=tr)
         return c
+
+    def anatomy_events(self) -> List[dict]:
+        """Every stage tracer's events, merged (the tracers share one
+        clock, so timestamps are directly comparable) — the input
+        :func:`apex_tpu.observability.anatomy.reconstruct` expects.
+        Requires ``trace=True``; pass ``measure_ops=True`` for span
+        durations that include device time."""
+        if self.tracers is None:
+            raise ValueError("engine built with trace=False; pass "
+                             "trace=True (or measure_ops=True) to "
+                             "record per-op anatomy events")
+        events: List[dict] = []
+        for tr in self.tracers:
+            events.extend(tr.events)
+        return events

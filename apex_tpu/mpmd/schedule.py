@@ -189,10 +189,17 @@ def simulate(order: Sequence[Op], n_stages: int, n_microbatches: int, *,
     async-send model (the host hands the payload to the channel and
     the stage keeps computing).
 
-    Returns ``makespan``, ``bubble_fraction`` (1 − mean busy /
-    makespan), per-link-class totals ``link_time`` and ``exposed``
-    (seconds a stage actually waited on a hop beyond its own
-    readiness), and ``hidden_fraction`` per class."""
+    Returns ``makespan``, ``busy`` (per-stage busy seconds — the
+    per-stage granularity the anatomy differ aligns measured stages
+    against), ``bubble_fraction`` (1 − mean busy / makespan),
+    per-link-class totals ``link_time`` and ``exposed`` (seconds a
+    stage actually waited on a hop beyond its own readiness),
+    ``hidden_fraction`` per class, plus the full predicted timeline:
+    ``op_times`` (one ``{stage, kind, mb, start, end}`` row per op, in
+    issue order) and ``xfers`` (one ``{src, dst, kind, mb, link_class,
+    start, end}`` row per stage-boundary transfer) — the records
+    :mod:`apex_tpu.observability.anatomy` reconstructs and diffs a
+    measured run against."""
     S, M = int(n_stages), int(n_microbatches)
     validate_order(order, S, M)
     link_seconds = dict(link_seconds or {})
@@ -203,6 +210,8 @@ def simulate(order: Sequence[Op], n_stages: int, n_microbatches: int, *,
     out_t: Dict[Tuple[int, str, int], float] = {}
     link_time = {"ici": 0.0, "dcn": 0.0}
     exposed = {"ici": 0.0, "dcn": 0.0}
+    op_times: List[Dict[str, object]] = []
+    xfers: List[Dict[str, object]] = []
 
     for op in order:
         s, kind, m = op
@@ -218,11 +227,16 @@ def simulate(order: Sequence[Op], n_stages: int, n_microbatches: int, *,
             link_time[lc] += link
             start = max(free[s], arrival)
             exposed[lc] += max(0.0, arrival - max(free[s], produced))
+            xfers.append({"src": src, "dst": s, "kind": kind, "mb": m,
+                          "link_class": lc, "start": produced,
+                          "end": arrival})
         else:
             start = free[s]
         end = start + dur
         busy[s] += dur
         out_t[(s, kind, m)] = end
+        op_times.append({"stage": s, "kind": kind, "mb": m,
+                         "start": start, "end": end})
         sends = (kind == "fwd" and s < S - 1) or (kind == "bwd" and s > 0)
         if sends and blocking_sends:
             dst_edge = s if kind == "fwd" else s - 1
@@ -241,4 +255,6 @@ def simulate(order: Sequence[Op], n_stages: int, n_microbatches: int, *,
         "link_time": link_time,
         "exposed": exposed,
         "hidden_fraction": hidden,
+        "op_times": op_times,
+        "xfers": xfers,
     }

@@ -43,6 +43,13 @@ summary (``--trace`` merges it with a span trace onto one timeline);
 ``docs/source/observability.md`` is the user guide.
 """
 
+from apex_tpu.observability.anatomy import (
+    MeasuredTimeline,
+    attribute,
+    diff_timelines,
+    reconstruct,
+    synthesize_events,
+)
 from apex_tpu.observability.registry import (
     Counter,
     Gauge,
@@ -84,6 +91,11 @@ from apex_tpu.observability.slo import (
 )
 
 __all__ = [
+    "MeasuredTimeline",
+    "attribute",
+    "diff_timelines",
+    "reconstruct",
+    "synthesize_events",
     "Counter",
     "Gauge",
     "Histogram",

@@ -17,6 +17,11 @@ loop with the same discipline as
    :class:`~apex_tpu.observability.costmodel.Measurement` points (what
    ``LocalDcnChannel`` transfers and per-request traces carry).
    Nothing stalls: points are buffered by ``CostModel.update``.
+   :meth:`ParallelismAutopilot.observe_anatomy` is the third feed —
+   measured-vs-predicted timeline diffs from
+   :mod:`apex_tpu.observability.anatomy`, the STRUCTURAL drift
+   channel (mis-ordered ops, unpredicted bubbles) that curve refits
+   cannot see.
 2. **Detect.** Each tick refits the buffer (GSPMD's premise taken to
    run-time: the machine profile is data, not configuration).  A refit
    whose curves moved past ``drift_threshold`` relative to the loaded
@@ -110,6 +115,7 @@ class ParallelismAutopilot:
     def __init__(self, trainer, profile: CostModel, *,
                  ranker: Optional[Callable] = None,
                  drift_threshold: float = 0.3,
+                 structural_threshold: Optional[float] = None,
                  confirm_windows: int = 2,
                  min_measurements: int = 8,
                  cooldown_s: float = 60.0,
@@ -140,6 +146,11 @@ class ParallelismAutopilot:
         self.profile = profile
         self.ranker = ranker
         self.drift_threshold = float(drift_threshold)
+        self.structural_threshold = float(
+            drift_threshold if structural_threshold is None
+            else structural_threshold)
+        if self.structural_threshold <= 0.0:
+            raise ValueError("structural_threshold must be > 0")
         self.confirm_windows = int(confirm_windows)
         self.min_measurements = int(min_measurements)
         self.cooldown_s = float(cooldown_s)
@@ -159,6 +170,7 @@ class ParallelismAutopilot:
 
         self._tick = 0
         self._streak = 0
+        self._anat_streak = 0
         self._cooldown_until = float("-inf")
         self._queue: Deque[dict] = collections.deque()
         self._adoption: Optional[_Adoption] = None
@@ -175,7 +187,9 @@ class ParallelismAutopilot:
         self.stats = {"refits": 0, "drift_confirmed": 0, "adoptions": 0,
                       "rollbacks": 0, "no_change": 0, "queued": 0,
                       "drift_faults": 0, "last_drift": None,
-                      "last_refit_s": 0.0, "last_adoption": None}
+                      "last_refit_s": 0.0, "last_adoption": None,
+                      "structural_confirmed": 0,
+                      "last_structural": None}
 
         self._g_drift = self._c_adopt = self._h_refit = None
         if registry is not None:
@@ -199,6 +213,61 @@ class ParallelismAutopilot:
         count.  Non-blocking — nothing is fitted until a tick's refit
         window."""
         return self.profile.update(measurements)
+
+    def observe_anatomy(self, report) -> bool:
+        """Feed one step's measured-vs-predicted timeline diff (the
+        dict :func:`apex_tpu.observability.anatomy.diff_timelines`
+        returns, or its bare ``drift_score``).
+
+        This is the STRUCTURAL drift channel: the cost-model path
+        sees curve drift (links got slower), this one sees the
+        schedule itself diverging from the model — mis-ordered ops,
+        bubbles the simulator didn't predict, one stage's ops
+        suddenly off-median.  Scores at or past
+        ``structural_threshold`` build their own confirmation streak
+        (same ``confirm_windows`` debounce as refit drift, so one
+        noisy step never moves a plan); a confirmed streak queues an
+        adoption pass carrying the score and the report's worst
+        offenders.  Returns True when this call confirmed."""
+        if isinstance(report, dict):
+            score = float(report.get("drift_score", 0.0))
+            detail = {"worst_op": report.get("worst_op"),
+                      "median_ratio": report.get("median_ratio"),
+                      "unpredicted_bubble_fraction":
+                          report.get("unpredicted_bubble_fraction"),
+                      "misordered": len(report.get("misordered", []))}
+        else:
+            score = float(report)
+            detail = {}
+        self.stats["last_structural"] = score
+        if score >= self.structural_threshold:
+            self._anat_streak += 1
+        else:
+            self._anat_streak = 0
+        self._record("anatomy", score=round(score, 6),
+                     streak=self._anat_streak, **detail)
+        if self._anat_streak < self.confirm_windows:
+            return False
+        self._anat_streak = 0
+        self.stats["structural_confirmed"] += 1
+        if self._g_drift is not None:
+            self._g_drift.set(1)
+        # coalesce with a pending structural request (same discipline
+        # as _confirm_drift: an ongoing divergence re-confirms every
+        # confirm_windows steps — refresh, don't pile up)
+        for req in self._queue:
+            if not req["manual"] and req.get("source") == "anatomy":
+                req["drift"] = score
+                req["detail"] = detail
+                self._record("structural_confirmed", drift=score,
+                             coalesced=True)
+                return True
+        self._queue.append({"model": None, "drift": score,
+                            "manual": False, "source": "anatomy",
+                            "detail": detail})
+        self.stats["queued"] += 1
+        self._record("structural_confirmed", drift=score)
+        return True
 
     def record_step(self, dt: float) -> None:
         """Feed one measured training step duration.  Drives the rolling
@@ -330,7 +399,7 @@ class ParallelismAutopilot:
         # later start its own adoption: plan churn, exactly what the
         # audit calls flapping)
         for req in self._queue:
-            if not req["manual"]:
+            if not req["manual"] and req.get("source") != "anatomy":
                 req["model"] = self._candidate
                 req["drift"] = self.stats["last_drift"]
                 self._record("drift_confirmed", drift=req["drift"],
@@ -338,7 +407,7 @@ class ParallelismAutopilot:
                 return
         self._queue.append({"model": self._candidate,
                             "drift": self.stats["last_drift"],
-                            "manual": False})
+                            "manual": False, "source": "cost"})
         self.stats["queued"] += 1
         self._record("drift_confirmed", drift=self.stats["last_drift"])
 
@@ -393,9 +462,14 @@ class ParallelismAutopilot:
         entry = {"tick": self._tick, "t": now,
                  "drift": req.get("drift"),
                  "manual": bool(req.get("manual")),
+                 "source": req.get("source",
+                                   "manual" if req.get("manual")
+                                   else "cost"),
                  "cooldown_ok": now >= self._cooldown_until,
                  "fault": False, "old": None, "new": None,
                  "outcome": None, "reason": None}
+        if req.get("detail"):
+            entry["detail"] = req["detail"]
         self.adoption_log.append(entry)
         t0 = time.perf_counter()
         ranked = self._rank_plans()
@@ -529,12 +603,17 @@ class ParallelismAutopilot:
         """Replay the adoption log against the controller's own rules;
         a well-behaved run returns ``[]``.  Flags (a) a non-manual
         adoption that started without a confirmed over-threshold drift
-        and (b) any adoption that started before cooldown expiry —
-        the plan-churn analogue of capacity flapping."""
+        (``cost`` entries against ``drift_threshold``, ``anatomy``
+        entries against ``structural_threshold``) and (b) any adoption
+        that started before cooldown expiry — the plan-churn analogue
+        of capacity flapping."""
         out = []
         for e in self.adoption_log:
+            thr = (self.structural_threshold
+                   if e.get("source") == "anatomy"
+                   else self.drift_threshold)
             if not e["manual"] and (e["drift"] is None
-                                    or e["drift"] < self.drift_threshold):
+                                    or e["drift"] < thr):
                 out.append({"tick": e["tick"], "drift": e["drift"],
                             "reason": "adoption started without a "
                                       "confirmed drift past the "

@@ -2214,6 +2214,161 @@ def bench_mfu_multichip():
     return report
 
 
+def bench_anatomy():
+    """Step-anatomy leg (ISSUE 20): what the measured critical-path
+    profiler costs and whether its attribution stays exact.
+
+    Three parts.  (1) A deterministic synthetic core: simulate a
+    4-stage/8-microbatch 1F1B schedule with a slow DCN edge,
+    synthesize its trace events, reconstruct + attribute, and
+    self-diff against the generating simulation — the attribution
+    must sum to the makespan exactly, per-op ratios must cover every
+    op, and the self-diff drift must be ~0 (pure host arithmetic, so
+    the recorded fractions are bench_diff-able across rounds).
+    (2) The paired-window trace-overhead gate: the SAME dp2 x pp2
+    ``MpmdPipeline`` step run bare vs ``trace=True`` back-to-back,
+    median per-pass ratio, < 2% target — the established
+    observability-leg protocol.  (3) One ``measure_ops=True`` step
+    reconstructed and attributed for real (wall numbers advisory:
+    host-serial dispatch on a shared CPU is honest but noisy)."""
+    from apex_tpu.mpmd.schedule import (SCHEDULES, edge_link_classes,
+                                        simulate)
+    from apex_tpu.observability.anatomy import (
+        CATEGORIES, attribute, diff_timelines, reconstruct,
+        synthesize_events)
+
+    S, M, pods = 4, 8, 2
+    classes = edge_link_classes(S, pods)
+    link = {e: (1.5 if lc == "dcn" else 0.05)
+            for e, lc in classes.items()}
+    order = SCHEDULES["1f1b"](S, M)
+    sim = simulate(order, S, M, t_fwd=1.0, t_bwd=2.0,
+                   link_seconds=link, link_classes=classes,
+                   blocking_sends=False)
+    evs = synthesize_events(sim, n_stages=S, n_microbatches=M)
+    reps = 20
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        tl = reconstruct(evs)
+        attr = attribute(tl)
+    anat_s = (time.perf_counter() - t0) / reps
+    err = max(abs(st["total"] - attr["makespan"])
+              for st in attr["per_stage"]) / attr["makespan"]
+    self_diff = diff_timelines(tl, sim)
+    out = {
+        "stages": S, "microbatches": M, "events": len(evs),
+        "reconstruct_attribute_s_advisory": round(anat_s, 6),
+        "attribution_rel_err": float(err),
+        "attribution_exact": bool(err < 1e-9),
+        "fractions": {c: round(attr["fractions"][c], 4)
+                      for c in CATEGORIES},
+        "self_drift_score": round(self_diff["drift_score"], 6),
+        "ratios_cover_all_ops": bool(
+            len(self_diff["ratios"]) == 2 * S * M),
+    }
+
+    n = len(jax.devices())
+    if n < 4:
+        out["engine"] = {"skipped": "needs >= 4 devices"}
+        return out
+    from apex_tpu.models.gpt import GPTConfig, GPTModel
+    from apex_tpu.mpmd import MpmdPipeline
+    from apex_tpu.parallel.plan import ParallelPlan
+
+    _free_calibration()
+    kw = dict(vocab_size=256, hidden_size=64, num_layers=4,
+              num_attention_heads=4, max_seq_len=32)
+    model = GPTModel(GPTConfig(**kw))
+    params = model.init_params(jax.random.PRNGKey(0))
+    plan = ParallelPlan(dp=2, pp=2, n_pods=2, n_microbatches=4)
+    devs = jax.devices()[:4]
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, 256, (2 * 4 * 2, 32)))
+    targets = jnp.asarray(rng.randint(0, 256, (2 * 4 * 2, 32)))
+    bare = MpmdPipeline(kw, params, plan, devices=devs)
+    traced = MpmdPipeline(kw, params, plan, devices=devs, trace=True)
+
+    def run_bare(tk, tg):
+        return bare.loss_and_grads(tk, tg, step=0)[0]
+
+    def run_traced(tk, tg):
+        for tr in traced.tracers:   # bound the event buffers
+            tr.clear()
+        return traced.loss_and_grads(tk, tg, step=0)[0]
+
+    # paired windows: time bare and traced back-to-back each pass,
+    # headline is the median per-pass ratio (the < 2% protocol of
+    # bench_observability); a ~60ms host-serial step needs wide
+    # windows and several passes for the median to beat shared-host
+    # scheduler noise down to the gate's resolution
+    passes = []
+    for _ in range(9):
+        t_b = _time_steps(run_bare, (tokens, targets), warmup=1,
+                          iters=10, rounds=1)
+        t_t = _time_steps(run_traced, (tokens, targets), warmup=1,
+                          iters=10, rounds=1)
+        passes.append((t_b, t_t))
+    passes.sort(key=lambda p: p[1] / p[0])
+    t_b, t_t = passes[len(passes) // 2]
+    overhead = t_t / t_b - 1.0
+    out["engine"] = {
+        "bare_step_s_advisory": round(t_b, 6),
+        "traced_step_s_advisory": round(t_t, 6),
+        "trace_overhead_frac": round(overhead, 4),
+        "trace_overhead_target": 0.02,
+        "trace_overhead_ok": bool(overhead < 0.02),
+    }
+
+    # one honest measured step: block on every op, reconstruct,
+    # attribute, diff against the schedule priced at measured medians
+    anat = MpmdPipeline(kw, params, plan, devices=devs,
+                        measure_ops=True)
+    anat.loss_and_grads(tokens, targets, step=0)     # compile warmup
+    for tr in anat.tracers:
+        tr.clear()
+    anat.loss_and_grads(tokens, targets, step=1)
+    tl_r = reconstruct(anat.anatomy_events())
+    attr_r = attribute(tl_r)
+    err_r = max(abs(st["total"] - attr_r["makespan"])
+                for st in attr_r["per_stage"]) / attr_r["makespan"]
+
+    med = lambda xs: sorted(xs)[len(xs) // 2] if xs else 1e-6
+    durs = {"fwd": [], "bwd": []}
+    for o in tl_r.ops:
+        durs[o["kind"]].append(o["end"] - o["start"])
+    by_edge = {}
+    for x in tl_r.xfers:
+        if x["mb"] >= 0:
+            by_edge.setdefault(min(x["src"], x["dst"]), []).append(
+                x["end"] - x["start"])
+    sim_r = simulate(anat.order, 2, 4,
+                     t_fwd=med(durs["fwd"]) or med(durs["bwd"]),
+                     t_bwd=med(durs["bwd"]),
+                     link_seconds={e: med(ts)
+                                   for e, ts in by_edge.items()},
+                     link_classes=edge_link_classes(2, 2),
+                     blocking_sends=False)
+    d_r = diff_timelines(tl_r, sim_r, fold_last_fwd=True)
+    out["measured"] = {
+        "makespan_s_advisory": round(tl_r.makespan, 6),
+        "n_ops": len(tl_r.ops),
+        "attribution_rel_err": float(err_r),
+        "attribution_exact": bool(err_r < 1e-9),
+        "ratios_cover_all_ops": bool(
+            d_r["matched"] == d_r["n_ops"] == len(tl_r.ops)),
+        # real wall seconds on a shared host: advisory per key so a
+        # noisy round never flags a phantom component regression —
+        # the *.anatomy.json sidecar carries these for bench_diff's
+        # attribution-delta printing instead
+        **{f"{c}_s_advisory": round(attr_r["totals"][c], 6)
+           for c in CATEGORIES},
+        "drift_score_advisory": round(d_r["drift_score"], 4),
+        **{f"{c}_frac_advisory": round(attr_r["fractions"][c], 4)
+           for c in CATEGORIES},
+    }
+    return out
+
+
 def _extra_legs():
     """Leg name (as it appears under the result's ``extra``) -> bench
     function, for ``--legs`` subset runs."""
@@ -2239,6 +2394,7 @@ def _extra_legs():
         "lint": bench_lint,
         "autotune": bench_autotune,
         "mpmd": bench_mpmd,
+        "anatomy": bench_anatomy,
         "fused_ffn": bench_fused_ffn,
         "mfu_multichip": bench_mfu_multichip,
     }
@@ -2336,6 +2492,7 @@ def main(argv=None):
     lint_gate = _retry(bench_lint)
     autotune_leg = _retry(bench_autotune)
     mpmd = _retry(bench_mpmd)
+    anatomy = _retry(bench_anatomy)
     fused_ffn_leg = _retry(bench_fused_ffn)
     mfu_multichip = _retry(bench_mfu_multichip)
     rounded = lambda d: (None if d is None else
@@ -2374,6 +2531,7 @@ def main(argv=None):
             "lint": lint_gate,
             "autotune": autotune_leg,
             "mpmd": mpmd,
+            "anatomy": anatomy,
             "fused_ffn": fused_ffn_leg,
             "mfu_multichip": mfu_multichip,
         },

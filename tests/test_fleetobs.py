@@ -422,6 +422,21 @@ class TestBenchDiff:
         improved = bd.diff_legs(new, old, threshold=0.1)
         assert improved["regressions"] == []
 
+    def test_diff_legs_noise_floor(self):
+        bd = _tools()
+        # one recorded-resolution ULP: 20% relative, zero information
+        old = {"leg": {"rank_s": 5e-05, "step_time_s": 1.0}}
+        new = {"leg": {"rank_s": 6e-05, "step_time_s": 1.5}}
+        res = bd.diff_legs(old, new, threshold=0.1)
+        assert {r["key"] for r in res["regressions"]} == {"step_time_s"}
+        # still reported as a row, just never gating
+        assert any(r["key"] == "rank_s" and not r["regressed"]
+                   for r in res["rows"])
+        # floor 0 restores the old behavior
+        res0 = bd.diff_legs(old, new, threshold=0.1, noise_floor=0.0)
+        assert {r["key"] for r in res0["regressions"]} \
+            == {"rank_s", "step_time_s"}
+
     def test_diff_legs_skips_near_zero_and_disjoint(self):
         bd = _tools()
         res = bd.diff_legs({"a": {"mfu": 0.0}, "gone": {"x": 1.0}},

@@ -15,7 +15,12 @@ rendered.  This tool is the automated reader:
   classifies each key's direction (``mfu`` / ``*_speedup`` /
   ``tokens_per_s`` higher-better; ``*_s`` / ``*overhead*`` / latency
   percentiles lower-better; unknown keys are reported, never flagged);
-* flags relative regressions beyond ``--threshold`` (default 10%).
+* flags relative regressions beyond ``--threshold`` (default 10%);
+* under ``--strict``, a regressed leg whose rounds BOTH have an
+  ``X.anatomy.json`` attribution sidecar (written by ``bench.py
+  --legs anatomy`` / ``tools/step_anatomy.py``) also gets its
+  component-level attribution delta printed — "ffn compute +12%, dcn
+  exposed flat" instead of a bare slower-step number.
 
 Usage:
     python tools/bench_diff.py                  # two newest committed rounds
@@ -152,10 +157,16 @@ def _flatten(d: dict, prefix: str = "") -> dict:
     return out
 
 
-def diff_legs(old: dict, new: dict, threshold: float = 0.1) -> dict:
+def diff_legs(old: dict, new: dict, threshold: float = 0.1,
+              noise_floor: float = 1e-4) -> dict:
     """Compare leg-by-leg; returns ``{"rows": [...], "regressions":
     [...], "legs_compared": n, "legs_only_old": [...],
-    "legs_only_new": [...]}``."""
+    "legs_only_new": [...]}``.
+
+    ``noise_floor`` is the smallest ABSOLUTE change that can flag: the
+    bench rounds timings to ~1e-5, so a 5e-05 -> 6e-05 micro-timing is
+    one ULP of the recorded value — 20% relative, zero information.
+    Sub-floor moves still appear in ``rows``, they just never gate."""
     rows, regressions = [], []
     shared = sorted(set(old) & set(new))
     for leg in shared:
@@ -166,8 +177,9 @@ def diff_legs(old: dict, new: dict, threshold: float = 0.1) -> dict:
             if abs(vo) < 1e-12:
                 continue
             rel = (vn - vo) / abs(vo)
-            regressed = (d == 1 and rel < -threshold) \
-                or (d == -1 and rel > threshold)
+            regressed = ((d == 1 and rel < -threshold)
+                         or (d == -1 and rel > threshold)) \
+                and abs(vn - vo) >= noise_floor
             row = {"leg": leg, "key": key, "old": vo, "new": vn,
                    "rel_change": rel,
                    "direction": {1: "higher_better", -1: "lower_better",
@@ -180,6 +192,53 @@ def diff_legs(old: dict, new: dict, threshold: float = 0.1) -> dict:
             "legs_compared": len(shared),
             "legs_only_old": sorted(set(old) - set(new)),
             "legs_only_new": sorted(set(new) - set(old))}
+
+
+def anatomy_sidecar(path: str) -> dict:
+    """The attribution sidecar next to a bench artifact —
+    ``X.anatomy.json`` for ``X.json``, holding ``{leg: {category:
+    seconds}}`` (what ``bench.py --legs anatomy`` and
+    ``tools/step_anatomy.py --json`` record).  Missing or malformed
+    sidecars return ``{}``: attribution deltas are best-effort
+    context, never a gate of their own."""
+    side = os.path.splitext(path)[0] + ".anatomy.json"
+    try:
+        with open(side, encoding="utf-8") as f:
+            obj = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    return obj if isinstance(obj, dict) else {}
+
+
+def attribution_delta(regressions, old_path: str,
+                      new_path: str) -> list:
+    """Component-level rows ("ffn compute +12%, dcn exposed flat")
+    for each regressed leg both rounds have attribution for."""
+    old_a, new_a = anatomy_sidecar(old_path), anatomy_sidecar(new_path)
+    rows = []
+    for leg in sorted({r["leg"] for r in regressions}):
+        o, n = old_a.get(leg), new_a.get(leg)
+        if not isinstance(o, dict) or not isinstance(n, dict):
+            continue
+        fo, fn = _flatten(o), _flatten(n)
+        for cat in sorted(set(fo) & set(fn)):
+            rows.append({"leg": leg, "category": cat,
+                         "old": fo[cat], "new": fn[cat],
+                         "delta": fn[cat] - fo[cat]})
+    return rows
+
+
+def render_attribution(rows: list, out=sys.stdout) -> None:
+    leg = None
+    for r in rows:
+        if r["leg"] != leg:
+            leg = r["leg"]
+            out.write(f"attribution delta for regressed leg "
+                      f"{leg}:\n")
+        rel = (f" ({(r['new'] - r['old']) / abs(r['old']):+.1%})"
+               if abs(r["old"]) > 1e-12 else "")
+        out.write(f"  {r['category']}: {r['old']:.6g} -> "
+                  f"{r['new']:.6g}{rel}\n")
 
 
 def render(result: dict, old_path: str, new_path: str,
@@ -224,6 +283,10 @@ def main(argv=None):
                          "current file is given)")
     ap.add_argument("--threshold", type=float, default=0.1,
                     help="relative regression threshold (default 0.10)")
+    ap.add_argument("--noise-floor", type=float, default=1e-4,
+                    help="smallest absolute change that can flag "
+                         "(default 1e-4: sub-resolution micro-timing "
+                         "jitter never gates)")
     ap.add_argument("--json", action="store_true",
                     help="emit the full diff as JSON")
     ap.add_argument("--strict", action="store_true",
@@ -253,14 +316,23 @@ def main(argv=None):
               f"({old_path}: {len(old_legs)} legs, "
               f"{new_path}: {len(new_legs)} legs)")
         return 0
-    result = diff_legs(old_legs, new_legs, threshold=args.threshold)
+    result = diff_legs(old_legs, new_legs, threshold=args.threshold,
+                       noise_floor=args.noise_floor)
+    attrib = []
+    if args.strict and result["regressions"]:
+        attrib = attribution_delta(result["regressions"], old_path,
+                                   new_path)
     if args.json:
-        json.dump({"old": old_path, "new": new_path,
-                   "threshold": args.threshold, **result},
-                  sys.stdout, indent=2)
+        payload = {"old": old_path, "new": new_path,
+                   "threshold": args.threshold, **result}
+        if attrib:
+            payload["attribution_delta"] = attrib
+        json.dump(payload, sys.stdout, indent=2)
         sys.stdout.write("\n")
     else:
         render(result, old_path, new_path, args.threshold)
+        if attrib:
+            render_attribution(attrib)
     return 1 if (args.strict and result["regressions"]) else 0
 
 
